@@ -1,0 +1,30 @@
+"""hydragnn_tpu — a TPU-native multi-headed graph neural network framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of ORNL/HydraGNN
+(multi-headed GNNs on atomistic data, 13 interchangeable message-passing
+architectures, GPS global attention, energy-conserving interatomic potentials,
+foundation-model multibranch training) designed for TPU hardware: statically
+padded graph batches, segment-op message passing, pjit/shard_map SPMD over
+device meshes, forces via jax.grad.
+
+Top-level API mirrors the reference (``hydragnn/__init__.py:1-3``):
+``run_training``, ``run_prediction`` plus subpackages.
+"""
+
+from . import graphs  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy imports keep `import hydragnn_tpu` light and avoid importing jax
+    # model code before test harnesses set platform env vars.
+    if name == "run_training":
+        from .run_training import run_training
+
+        return run_training
+    if name == "run_prediction":
+        from .run_prediction import run_prediction
+
+        return run_prediction
+    raise AttributeError(f"module 'hydragnn_tpu' has no attribute '{name}'")

@@ -1,0 +1,16 @@
+from .graph import GraphBatch, GraphSample
+from .batching import PadSpec, collate, compute_pad_spec, GraphLoader
+from .radius import radius_graph, build_radius_graph
+from . import segment
+
+__all__ = [
+    "GraphBatch",
+    "GraphSample",
+    "PadSpec",
+    "collate",
+    "compute_pad_spec",
+    "GraphLoader",
+    "radius_graph",
+    "build_radius_graph",
+    "segment",
+]

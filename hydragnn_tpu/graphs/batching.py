@@ -1,0 +1,218 @@
+"""Collating + padding graph samples into static-shape ``GraphBatch``es.
+
+Replaces PyG's ragged ``Batch.from_data_list`` (used throughout the reference's
+data pipeline, e.g. ``hydragnn/preprocess/load_data.py:226-334``) with a
+TPU-friendly scheme: every batch is padded up to a *bucket* — a static
+``(n_node, n_edge, n_graph)`` triple — so XLA compiles one program per bucket
+instead of one per batch shape.
+
+Padding convention:
+* padded node slots: features zero, assigned to the dummy padding graph
+  (graph id ``n_graph - 1``), ``node_mask = 0``;
+* padded edge slots: ``senders = receivers = n_node - 1`` (a padded node),
+  ``edge_mask = 0``;
+* one extra graph slot is always reserved for the padding graph, so a bucket
+  declared for ``B`` real graphs has ``n_graph = B + 1``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from .graph import GraphBatch, GraphSample
+
+
+def _round_up(value: int, multiple: int) -> int:
+    return int(math.ceil(max(value, 1) / multiple) * multiple)
+
+
+class PadSpec:
+    """A static padding bucket: (n_node, n_edge, n_graph) with n_graph
+    including the trailing dummy padding graph."""
+
+    __slots__ = ("n_node", "n_edge", "n_graph")
+
+    def __init__(self, n_node: int, n_edge: int, n_graph: int):
+        self.n_node = int(n_node)
+        self.n_edge = int(n_edge)
+        self.n_graph = int(n_graph)
+
+    def as_tuple(self) -> tuple[int, int, int]:
+        return (self.n_node, self.n_edge, self.n_graph)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PadSpec) and self.as_tuple() == other.as_tuple()
+
+    def __hash__(self) -> int:
+        return hash(self.as_tuple())
+
+    def __repr__(self) -> str:
+        return f"PadSpec(n_node={self.n_node}, n_edge={self.n_edge}, n_graph={self.n_graph})"
+
+
+def compute_pad_spec(
+    samples: Sequence[GraphSample],
+    batch_size: int,
+    node_multiple: int = 8,
+    edge_multiple: int = 128,
+    slack: float = 1.0,
+) -> PadSpec:
+    """Derive a bucket that fits any ``batch_size`` samples drawn from
+    ``samples``. Uses max-per-sample × batch_size (safe upper bound) rounded to
+    TPU-friendly multiples (8 sublanes / 128 lanes)."""
+    max_nodes = max((s.num_nodes for s in samples), default=1)
+    max_edges = max((s.num_edges for s in samples), default=1)
+    n_node = _round_up(int(max_nodes * batch_size * slack) + 1, node_multiple)
+    n_edge = _round_up(int(max_edges * batch_size * slack) + 1, edge_multiple)
+    return PadSpec(n_node=n_node, n_edge=n_edge, n_graph=batch_size + 1)
+
+
+def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
+    """Concatenate ``samples`` and pad to ``pad``. Raises if the bucket is too
+    small — padding must be sized by ``compute_pad_spec`` (or the config's
+    bucket table), never silently truncated."""
+    n_graphs = len(samples)
+    if n_graphs > pad.n_graph - 1:
+        raise ValueError(f"{n_graphs} graphs exceed bucket capacity {pad.n_graph - 1}")
+    tot_nodes = sum(s.num_nodes for s in samples)
+    tot_edges = sum(s.num_edges for s in samples)
+    # Strictly fewer real nodes than slots: padded edges are wired to node
+    # n_node-1, which must itself be a padding node or their (masked) messages
+    # would land on a real node during segment aggregation.
+    if tot_nodes >= pad.n_node or tot_edges > pad.n_edge:
+        raise ValueError(
+            f"batch ({tot_nodes} nodes, {tot_edges} edges) exceeds bucket {pad!r} "
+            f"(need tot_nodes < n_node to reserve a padding node)"
+        )
+
+    first = samples[0]
+    fx = first.x.shape[1]
+    fe = first.edge_attr.shape[1]
+    fg = first.graph_attr.shape[0]
+    yg = first.graph_y.shape[0]
+    yn = first.node_y.shape[1]
+
+    N, E, G = pad.n_node, pad.n_edge, pad.n_graph
+    x = np.zeros((N, fx), np.float32)
+    pos = np.zeros((N, 3), np.float32)
+    senders = np.full((E,), N - 1, np.int32)
+    receivers = np.full((E,), N - 1, np.int32)
+    edge_attr = np.zeros((E, fe), np.float32)
+    edge_shifts = np.zeros((E, 3), np.float32)
+    batch = np.full((N,), G - 1, np.int32)
+    graph_attr = np.zeros((G, fg), np.float32)
+    graph_y = np.zeros((G, yg), np.float32)
+    node_y = np.zeros((N, yn), np.float32)
+    energy_y = np.zeros((G, 1), np.float32)
+    forces_y = np.zeros((N, 3), np.float32)
+    node_mask = np.zeros((N,), np.float32)
+    edge_mask = np.zeros((E,), np.float32)
+    graph_mask = np.zeros((G,), np.float32)
+    n_node = np.zeros((G,), np.int32)
+    dataset_id = np.zeros((G,), np.int32)
+
+    node_off = 0
+    edge_off = 0
+    for g, s in enumerate(samples):
+        n, e = s.num_nodes, s.num_edges
+        x[node_off : node_off + n] = s.x
+        pos[node_off : node_off + n] = s.pos
+        senders[edge_off : edge_off + e] = s.senders + node_off
+        receivers[edge_off : edge_off + e] = s.receivers + node_off
+        if fe:
+            edge_attr[edge_off : edge_off + e] = s.edge_attr
+        edge_shifts[edge_off : edge_off + e] = s.edge_shifts
+        batch[node_off : node_off + n] = g
+        if fg:
+            graph_attr[g] = s.graph_attr
+        if yg:
+            graph_y[g] = s.graph_y
+        if yn:
+            node_y[node_off : node_off + n] = s.node_y
+        energy_y[g] = s.energy_y
+        forces_y[node_off : node_off + n] = s.forces_y
+        node_mask[node_off : node_off + n] = 1.0
+        edge_mask[edge_off : edge_off + e] = 1.0
+        graph_mask[g] = 1.0
+        n_node[g] = n
+        dataset_id[g] = s.dataset_id
+        node_off += n
+        edge_off += e
+
+    return GraphBatch(
+        x=x, pos=pos, senders=senders, receivers=receivers, edge_attr=edge_attr,
+        edge_shifts=edge_shifts, batch=batch, graph_attr=graph_attr,
+        graph_y=graph_y, node_y=node_y, energy_y=energy_y, forces_y=forces_y,
+        node_mask=node_mask, edge_mask=edge_mask, graph_mask=graph_mask,
+        n_node=n_node, dataset_id=dataset_id,
+    )
+
+
+class GraphLoader:
+    """Minimal host-side dataloader: shuffles, batches, collates to one bucket.
+
+    The DistributedSampler semantics of the reference
+    (``hydragnn/preprocess/load_data.py:252-282``) are reproduced by
+    ``shard(rank, world)``: each process iterates a disjoint, equally-sized
+    slice of the epoch permutation (padding the permutation to a multiple of
+    ``world`` like torch's DistributedSampler does).
+    """
+
+    def __init__(
+        self,
+        samples: Sequence[GraphSample],
+        batch_size: int,
+        pad: PadSpec | None = None,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        rank: int = 0,
+        world: int = 1,
+    ):
+        if not samples:
+            raise ValueError("empty dataset")
+        self.samples = list(samples)
+        self.batch_size = int(batch_size)
+        self.pad = pad or compute_pad_spec(self.samples, self.batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.rank = rank
+        self.world = world
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def _epoch_indices(self) -> np.ndarray:
+        n = len(self.samples)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            idx = rng.permutation(n)
+        else:
+            idx = np.arange(n)
+        if self.world > 1:
+            # pad to a multiple of world by wrapping, then stride-slice
+            total = int(math.ceil(n / self.world) * self.world)
+            if total > n:
+                idx = np.concatenate([idx, idx[: total - n]])
+            idx = idx[self.rank :: self.world]
+        return idx
+
+    def __len__(self) -> int:
+        n = len(self._epoch_indices())
+        if self.drop_last:
+            return n // self.batch_size
+        return int(math.ceil(n / self.batch_size))
+
+    def __iter__(self) -> Iterable[GraphBatch]:
+        idx = self._epoch_indices()
+        nb = len(self)
+        for b in range(nb):
+            chunk = idx[b * self.batch_size : (b + 1) * self.batch_size]
+            if len(chunk) == 0:
+                break
+            yield collate([self.samples[i] for i in chunk], self.pad)

@@ -1,0 +1,208 @@
+"""Padded, statically-shaped graph batches — the TPU-native replacement for
+PyG ``Data``/``Batch``.
+
+Design (differs deliberately from the reference):
+
+* The reference (ORNL/HydraGNN) batches variable-size graphs with PyG's ragged
+  ``Batch`` and indexes multi-head targets through a concatenated ``data.y`` plus
+  per-sample ``y_loc`` offset tensors (``hydragnn/preprocess/
+  graph_samples_checks_and_updates.py:604-645``, consumed by ``get_head_indices``
+  in ``hydragnn/train/train_validate_test.py:494-557``). Ragged shapes and
+  gather-by-offset are hostile to XLA: every batch would recompile.
+
+* Here every batch is padded to a static ``(n_node, n_edge, n_graph)`` bucket so
+  each bucket jit-compiles exactly once. Padded nodes/edges belong to a dummy
+  *padding graph* (the last graph slot), mirroring jraph's convention. Targets
+  are stored **columnar**: ``graph_y[, G, sum(graph head dims)]`` and
+  ``node_y[N, sum(node head dims)]`` — each head owns a fixed column slice, so
+  head indexing is a static slice instead of dynamic gather.
+
+All fields are numpy/jax arrays; the structure is a pytree (NamedTuple) and can
+cross ``jit``/``pjit`` boundaries and be sharded along the leading axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = Any  # np.ndarray on host, jax.Array on device
+
+
+class GraphBatch(NamedTuple):
+    """A batch of graphs padded to static shapes.
+
+    Shapes (N = padded node count, E = padded edge count, G = padded graph
+    count, incl. one trailing dummy graph absorbing padding):
+
+    - ``x``:        [N, F_in]   invariant node features
+    - ``pos``:      [N, 3]      atomic positions (zeros when absent)
+    - ``senders``:  [E]         edge source node ids (messages flow s -> r)
+    - ``receivers``:[E]         edge target node ids
+    - ``edge_attr``:[E, F_e]    edge features (zeros / zero-width when absent)
+    - ``edge_shifts``:[E, 3]    PBC cell shift vectors (r_vec = pos[r] - pos[s] + shift)
+    - ``batch``:    [N]         node -> graph segment ids
+    - ``graph_attr``:[G, F_g]   per-graph conditioning features
+    - ``graph_y``:  [G, Yg]     columnar graph-level targets
+    - ``node_y``:   [N, Yn]     columnar node-level targets
+    - ``energy_y``: [G, 1]      MLIP total energy target
+    - ``forces_y``: [N, 3]      MLIP force targets
+    - ``node_mask``:[N]         1.0 for real nodes
+    - ``edge_mask``:[E]         1.0 for real edges
+    - ``graph_mask``:[G]        1.0 for real graphs
+    - ``n_node``:   [G]         real node count per graph (0 for padding)
+    - ``dataset_id``:[G]        multidataset branch id per graph (int32)
+    """
+
+    x: Array
+    pos: Array
+    senders: Array
+    receivers: Array
+    edge_attr: Array
+    edge_shifts: Array
+    batch: Array
+    graph_attr: Array
+    graph_y: Array
+    node_y: Array
+    energy_y: Array
+    forces_y: Array
+    node_mask: Array
+    edge_mask: Array
+    graph_mask: Array
+    n_node: Array
+    dataset_id: Array
+
+    # -- static helpers -------------------------------------------------------
+    @property
+    def num_nodes(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_edges(self) -> int:
+        return self.senders.shape[0]
+
+    @property
+    def num_graphs(self) -> int:
+        return self.graph_mask.shape[0]
+
+    def edge_vectors(self) -> Array:
+        """Relative position vectors along edges, honoring PBC shifts.
+
+        The single geometry primitive shared by the equivariant stacks —
+        reference ``hydragnn/utils/model/operations.py:21-36``
+        (``get_edge_vectors_and_lengths``).
+        """
+        return self.pos[self.receivers] - self.pos[self.senders] + self.edge_shifts
+
+    def edge_lengths(self, eps: float = 1e-12) -> Array:
+        vec = self.edge_vectors()
+        return jnp.sqrt(jnp.sum(vec * vec, axis=-1, keepdims=True) + eps)
+
+    def replace(self, **kwargs) -> "GraphBatch":
+        return self._replace(**kwargs)
+
+
+class GraphSample:
+    """One host-side (numpy, unpadded) graph sample — the analog of PyG ``Data``.
+
+    Produced by dataset loaders and the radius-graph preprocessors; consumed by
+    ``hydragnn_tpu.graphs.batching.collate``. Plain attribute bag on purpose:
+    cheap to construct in data-loading hot loops, pickleable.
+    """
+
+    __slots__ = (
+        "x", "pos", "senders", "receivers", "edge_attr", "edge_shifts",
+        "graph_attr", "graph_y", "node_y", "energy_y", "forces_y",
+        "dataset_id", "cell", "pbc", "extras",
+    )
+
+    def __init__(
+        self,
+        x: np.ndarray,
+        pos: np.ndarray | None = None,
+        senders: np.ndarray | None = None,
+        receivers: np.ndarray | None = None,
+        edge_attr: np.ndarray | None = None,
+        edge_shifts: np.ndarray | None = None,
+        graph_attr: np.ndarray | None = None,
+        graph_y: np.ndarray | None = None,
+        node_y: np.ndarray | None = None,
+        energy_y: np.ndarray | None = None,
+        forces_y: np.ndarray | None = None,
+        dataset_id: int = 0,
+        cell: np.ndarray | None = None,
+        pbc: np.ndarray | None = None,
+        extras: dict | None = None,
+    ):
+        self.x = np.asarray(x, dtype=np.float32)
+        n = self.x.shape[0]
+        self.pos = (
+            np.asarray(pos, dtype=np.float32)
+            if pos is not None
+            else np.zeros((n, 3), np.float32)
+        )
+        self.senders = (
+            np.asarray(senders, dtype=np.int32) if senders is not None else np.zeros((0,), np.int32)
+        )
+        self.receivers = (
+            np.asarray(receivers, dtype=np.int32)
+            if receivers is not None
+            else np.zeros((0,), np.int32)
+        )
+        e = self.senders.shape[0]
+        self.edge_attr = (
+            np.asarray(edge_attr, dtype=np.float32)
+            if edge_attr is not None
+            else np.zeros((e, 0), np.float32)
+        )
+        self.edge_shifts = (
+            np.asarray(edge_shifts, dtype=np.float32)
+            if edge_shifts is not None
+            else np.zeros((e, 3), np.float32)
+        )
+        self.graph_attr = (
+            np.asarray(graph_attr, dtype=np.float32).reshape(-1)
+            if graph_attr is not None
+            else np.zeros((0,), np.float32)
+        )
+        self.graph_y = (
+            np.asarray(graph_y, dtype=np.float32).reshape(-1)
+            if graph_y is not None
+            else np.zeros((0,), np.float32)
+        )
+        self.node_y = (
+            np.asarray(node_y, dtype=np.float32).reshape(n, -1)
+            if node_y is not None
+            else np.zeros((n, 0), np.float32)
+        )
+        self.energy_y = (
+            np.asarray(energy_y, dtype=np.float32).reshape(1)
+            if energy_y is not None
+            else np.zeros((1,), np.float32)
+        )
+        self.forces_y = (
+            np.asarray(forces_y, dtype=np.float32).reshape(n, 3)
+            if forces_y is not None
+            else np.zeros((n, 3), np.float32)
+        )
+        self.dataset_id = int(dataset_id)
+        self.cell = None if cell is None else np.asarray(cell, dtype=np.float64).reshape(3, 3)
+        self.pbc = None if pbc is None else np.asarray(pbc, dtype=bool).reshape(3)
+        self.extras = extras or {}
+
+    @property
+    def num_nodes(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_edges(self) -> int:
+        return self.senders.shape[0]
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"GraphSample(nodes={self.num_nodes}, edges={self.num_edges}, "
+            f"x={self.x.shape}, graph_y={self.graph_y.shape}, node_y={self.node_y.shape})"
+        )

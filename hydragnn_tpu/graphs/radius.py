@@ -1,0 +1,211 @@
+"""Radius-graph construction with periodic boundary conditions (host-side numpy).
+
+Reproduces the semantics of the reference's ``RadiusGraph``/``RadiusGraphPBC``
+transforms (``hydragnn/preprocess/graph_samples_checks_and_updates.py:144-417``,
+which delegate neighbor search to the native ``vesin`` library) without vesin:
+a pure-numpy cell-list over atoms and their periodic images. Graph construction
+is host-side preprocessing — it happens once per sample when datasets are
+serialized, never inside the jitted train step — so numpy is the right tool; the
+on-device analog for MLIP molecular dynamics (dynamic graphs) is a future Pallas
+cell-list kernel.
+
+Semantics mirrored from the reference:
+* edges are *directed* pairs (i, j) with ``dist(i, j) <= r`` (strictly positive
+  — no self loops unless via a periodic image);
+* with PBC, an atom pair may contribute several edges (one per image within the
+  cutoff); each edge carries its Cartesian ``cell shift`` so
+  ``r_vec = pos[j] - pos[i] + shift`` (reference
+  ``utils/model/operations.py:21-36``);
+* ``max_neighbours`` keeps only the nearest ``k`` incoming edges per node
+  (reference's vectorized pruning at ``:266-298``);
+* mixed PBC (periodic along a subset of axes) supported, as in the reference's
+  mixed-PBC workaround (``:356-414``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import defaultdict
+
+import numpy as np
+
+from .graph import GraphSample
+
+# Above this point count the O(n^2) pairwise matrix is replaced by grid binning.
+_BRUTE_FORCE_LIMIT = 512
+
+
+def _candidate_shifts(cell: np.ndarray, pbc: np.ndarray, radius: float) -> np.ndarray:
+    """Integer image shifts within which any point of the unit cell can have a
+    neighbor inside ``radius``, bounded per-axis by the lattice plane spacings.
+
+    Row convention: ``cell`` rows are the lattice vectors (``pos = frac @ cell``),
+    so the reciprocal vectors are the *columns* of ``inv(cell)`` and the spacing
+    between the (100)/(010)/(001) plane families is ``1 / ||inv(cell)[:, i]||``.
+    """
+    inv = np.linalg.inv(cell)
+    plane_d = 1.0 / np.linalg.norm(inv, axis=0)
+    n_rep = np.where(pbc, np.ceil(radius / plane_d).astype(int), 0)
+    ranges = [range(-int(n), int(n) + 1) for n in n_rep]
+    return np.array(list(itertools.product(*ranges)), dtype=np.int64)
+
+
+def _pairs_within(
+    query: np.ndarray, points: np.ndarray, radius: float
+) -> tuple[np.ndarray, np.ndarray]:
+    """All (qi, pj) index pairs with ``||points[pj] - query[qi]|| <= radius``.
+
+    Dense O(nm) for small inputs, grid-binned cell list otherwise (near-linear).
+    """
+    n, m = query.shape[0], points.shape[0]
+    r2 = radius * radius
+    if n * m <= _BRUTE_FORCE_LIMIT * _BRUTE_FORCE_LIMIT:
+        d2 = np.sum((points[None, :, :] - query[:, None, :]) ** 2, axis=-1)
+        qi, pj = np.nonzero(d2 <= r2)
+        return qi, pj
+
+    mins = np.minimum(query.min(axis=0), points.min(axis=0))
+    qbins = np.floor((query - mins) / radius).astype(np.int64)
+    pbins = np.floor((points - mins) / radius).astype(np.int64)
+    bucket: dict[tuple, list[int]] = defaultdict(list)
+    for j in range(m):
+        bucket[tuple(pbins[j])].append(j)
+    offsets = np.array(list(itertools.product((-1, 0, 1), repeat=3)), dtype=np.int64)
+    out_q: list[np.ndarray] = []
+    out_p: list[np.ndarray] = []
+    # group query atoms by bin so each bin's neighborhood is looked up once
+    qbucket: dict[tuple, list[int]] = defaultdict(list)
+    for i in range(n):
+        qbucket[tuple(qbins[i])].append(i)
+    for key, members in qbucket.items():
+        neigh: list[int] = []
+        for off in offsets:
+            neigh.extend(bucket.get(tuple(np.asarray(key) + off), ()))
+        if not neigh:
+            continue
+        mem = np.asarray(members)
+        ngh = np.asarray(neigh)
+        d2 = np.sum((points[ngh][None, :, :] - query[mem][:, None, :]) ** 2, axis=-1)
+        ii, jj = np.nonzero(d2 <= r2)
+        out_q.append(mem[ii])
+        out_p.append(ngh[jj])
+    if not out_q:
+        z = np.zeros((0,), np.int64)
+        return z, z
+    return np.concatenate(out_q), np.concatenate(out_p)
+
+
+def radius_graph(
+    pos: np.ndarray,
+    radius: float,
+    cell: np.ndarray | None = None,
+    pbc: np.ndarray | None = None,
+    max_neighbours: int | None = None,
+    loop: bool = False,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Build a directed radius graph.
+
+    Returns ``(senders, receivers, shift_vectors)`` where ``shift_vectors`` are
+    already in Cartesian coordinates (``integer_shift @ cell``), i.e. what
+    ``GraphBatch.edge_shifts`` stores. Convention: edge (s, r) carries the
+    message s -> r and geometric vector ``pos[r] - pos[s] + shift``.
+    """
+    pos = np.asarray(pos, dtype=np.float64)
+    n = pos.shape[0]
+    if n == 0 or radius <= 0:
+        z = np.zeros((0,), np.int32)
+        return z, z, np.zeros((0, 3), np.float32)
+
+    if cell is None or pbc is None or not np.any(pbc):
+        senders, receivers = _pairs_within(pos, pos, radius)
+        if not loop:
+            keep = senders != receivers
+            senders, receivers = senders[keep], receivers[keep]
+        shifts = np.zeros((senders.shape[0], 3), np.float64)
+    else:
+        cell = np.asarray(cell, dtype=np.float64).reshape(3, 3)
+        pbc = np.asarray(pbc, dtype=bool).reshape(3)
+        senders, receivers, shifts = _radius_graph_pbc(pos, radius, cell, pbc, loop=loop)
+
+    if max_neighbours is not None and senders.shape[0] > 0:
+        senders, receivers, shifts = _prune_max_neighbours(
+            pos, senders, receivers, shifts, max_neighbours
+        )
+    return senders.astype(np.int32), receivers.astype(np.int32), shifts.astype(np.float32)
+
+
+def _radius_graph_pbc(
+    pos: np.ndarray, radius: float, cell: np.ndarray, pbc: np.ndarray, loop: bool
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Periodic neighbor search: one cell-list query of the original atoms
+    against the cloud of atom images within the candidate shift window
+    (vesin-equivalent semantics; each in-range image contributes its own edge)."""
+    shifts_int = _candidate_shifts(cell, pbc, radius)
+    n_shift = shifts_int.shape[0]
+    n = pos.shape[0]
+    disp = shifts_int @ cell  # [S, 3] Cartesian image displacements
+    # image cloud: images[k] = pos[k % n] + disp[k // n]
+    images = (pos[None, :, :] + disp[:, None, :]).reshape(n_shift * n, 3)
+    qi, pj = _pairs_within(pos, images, radius)
+    receivers = pj % n
+    shift_idx = pj // n
+    senders = qi
+    # edge s -> r with vector (pos[r] + disp) - pos[s]
+    shifts_cart = disp[shift_idx]
+    d = np.linalg.norm(pos[receivers] + shifts_cart - pos[senders], axis=1)
+    keep = d > 1e-12  # drop exact self (and degenerate zero-distance images)
+    if loop:
+        is_zero_shift = np.all(shifts_int[shift_idx] == 0, axis=1)
+        keep |= (senders == receivers) & is_zero_shift
+    s, r, sh = senders[keep], receivers[keep], shifts_cart[keep]
+    return s, r, sh
+
+
+def _prune_max_neighbours(
+    pos: np.ndarray,
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    shifts: np.ndarray,
+    k: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Keep, per receiver, only its ``k`` nearest incoming edges (reference's
+    vectorized max-neighbor pruning, ``graph_samples_checks_and_updates.py:266-298``)."""
+    if k <= 0:
+        z = np.zeros((0,), senders.dtype)
+        return z, z, np.zeros((0, 3), shifts.dtype)
+    vec = pos[receivers] - pos[senders] + shifts
+    dist = np.linalg.norm(vec, axis=1)
+    # stable sort by (receiver, distance) then take first k per receiver
+    order = np.lexsort((dist, receivers))
+    receivers_sorted = receivers[order]
+    # rank within each receiver group
+    is_new = np.ones(len(order), dtype=bool)
+    is_new[1:] = receivers_sorted[1:] != receivers_sorted[:-1]
+    group_start = np.maximum.accumulate(np.where(is_new, np.arange(len(order)), 0))
+    rank = np.arange(len(order)) - group_start
+    keep = order[rank < k]
+    keep.sort()
+    return senders[keep], receivers[keep], shifts[keep]
+
+
+def build_radius_graph(
+    sample: GraphSample,
+    radius: float,
+    max_neighbours: int | None = None,
+    loop: bool = False,
+) -> GraphSample:
+    """Attach a radius graph (with PBC if ``sample.cell``/``sample.pbc`` set)
+    to a ``GraphSample`` in place; returns the sample for chaining."""
+    s, r, shifts = radius_graph(
+        sample.pos,
+        radius,
+        cell=sample.cell,
+        pbc=sample.pbc,
+        max_neighbours=max_neighbours,
+        loop=loop,
+    )
+    sample.senders = s
+    sample.receivers = r
+    sample.edge_shifts = shifts
+    sample.edge_attr = np.zeros((s.shape[0], 0), np.float32)
+    return sample

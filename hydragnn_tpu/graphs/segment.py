@@ -1,0 +1,137 @@
+"""Segment reductions — the TPU-native replacement for torch_scatter.
+
+The reference's message-passing hot loop bottoms out in ``scatter_add`` over edges
+(PyG ``MessagePassing.propagate``; see reference ``hydragnn/models/Base.py`` and
+EGNN's ``unsorted_segment_sum`` at ``hydragnn/models/EGCLStack.py:294-300``).
+On TPU the idiomatic equivalent is ``jax.ops.segment_sum`` with a *static*
+``num_segments``, which XLA lowers to a one-hot matmul or sorted-scatter that
+tiles onto the MXU/VPU. All ops here require static segment counts — that is the
+contract that keeps every train step a single compiled XLA program.
+
+Padding convention (see ``hydragnn_tpu.graphs.graph``): padded elements carry a
+segment id pointing at a dedicated dummy segment (the last one), so reductions
+over real segments are unaffected; masks are only needed when *reading* results.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _zero_empty(out: Array, identity: Array) -> Array:
+    """Replace untouched (empty-segment) entries, which jax.ops fills with the
+    reduction identity (±inf for floats, iinfo extremes for ints), with zeros."""
+    if jnp.issubdtype(out.dtype, jnp.floating):
+        return jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+    return jnp.where(out == identity, jnp.zeros_like(out), out)
+
+
+def segment_sum(data: Array, segment_ids: Array, num_segments: int) -> Array:
+    """Sum ``data`` rows into ``num_segments`` buckets by ``segment_ids``."""
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def segment_count(segment_ids: Array, num_segments: int, weights: Array | None = None) -> Array:
+    """Number of (optionally weighted) elements per segment, shape [num_segments]."""
+    ones = jnp.ones(segment_ids.shape[0], dtype=jnp.float32) if weights is None else weights
+    return jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(
+    data: Array, segment_ids: Array, num_segments: int, eps: float = 1e-12
+) -> Array:
+    """Mean per segment; empty segments yield zeros (matches torch_scatter 'mean')."""
+    total = segment_sum(data, segment_ids, num_segments)
+    count = segment_count(segment_ids, num_segments)
+    count = jnp.maximum(count, eps).astype(total.dtype)
+    return total / count.reshape((-1,) + (1,) * (total.ndim - 1))
+
+
+def segment_max(data: Array, segment_ids: Array, num_segments: int) -> Array:
+    """Max per segment; empty segments yield 0 (PyG ``global_max_pool`` on empty
+    graphs is undefined — we pick 0 so padded dummy graphs stay finite)."""
+    out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
+    identity = None
+    if not jnp.issubdtype(out.dtype, jnp.floating):
+        identity = jnp.iinfo(out.dtype).min
+    return _zero_empty(out, identity)
+
+
+def segment_min(data: Array, segment_ids: Array, num_segments: int) -> Array:
+    out = jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
+    identity = None
+    if not jnp.issubdtype(out.dtype, jnp.floating):
+        identity = jnp.iinfo(out.dtype).max
+    return _zero_empty(out, identity)
+
+
+def segment_std(
+    data: Array, segment_ids: Array, num_segments: int, eps: float = 1e-5
+) -> Array:
+    """Per-segment standard deviation (biased, matching PyG ``StdAggregation``
+    used by PNA's 'std' aggregator)."""
+    mean = segment_mean(data, segment_ids, num_segments)
+    mean_sq = segment_mean(data * data, segment_ids, num_segments)
+    var = jnp.maximum(mean_sq - mean * mean, 0.0)
+    return jnp.sqrt(var + eps)
+
+
+def segment_softmax(
+    logits: Array, segment_ids: Array, num_segments: int
+) -> Array:
+    """Numerically-stable softmax within each segment (GAT attention weights).
+
+    Returns an array the same shape as ``logits``; padded entries (pointing at
+    the dummy segment) get well-defined finite values and must be masked by the
+    caller if they would otherwise contribute.
+    """
+    seg_max = jax.ops.segment_max(
+        jax.lax.stop_gradient(logits), segment_ids, num_segments=num_segments
+    )
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, jnp.zeros_like(seg_max))
+    shifted = logits - seg_max[segment_ids]
+    exp = jnp.exp(shifted)
+    denom = segment_sum(exp, segment_ids, num_segments)
+    denom = jnp.maximum(denom, 1e-12)
+    return exp / denom[segment_ids]
+
+
+def segment_normalize(
+    data: Array, segment_ids: Array, num_segments: int, eps: float = 1e-12
+) -> Array:
+    """Divide each element by its segment's sum (degree-normalized aggregation)."""
+    denom = segment_sum(data, segment_ids, num_segments)
+    denom = jnp.where(jnp.abs(denom) < eps, jnp.ones_like(denom), denom)
+    return data / denom[segment_ids]
+
+
+_POOL_FNS = {
+    "add": segment_sum,
+    "sum": segment_sum,
+    "mean": segment_mean,
+    "max": segment_max,
+    "min": segment_min,
+}
+
+
+def global_pool(kind: str, data: Array, segment_ids: Array, num_segments: int) -> Array:
+    """Graph-level readout: the reference's ``global_{mean,add,max}_pool``
+    (``hydragnn/models/Base.py:147-170``) as one masked segment reduction."""
+    try:
+        fn = _POOL_FNS[kind]
+    except KeyError:
+        raise ValueError(f"Unknown pooling '{kind}'; expected one of {sorted(_POOL_FNS)}")
+    return fn(data, segment_ids, num_segments)
+
+
+def scatter_degree(
+    segment_ids: Array, num_segments: int, dtype=jnp.float32
+) -> Array:
+    """In-degree per receiver node — used by PNA degree scalers and SAGE/MFC
+    normalization. Shape [num_segments]."""
+    return segment_count(segment_ids, num_segments).astype(dtype)

@@ -1,0 +1,101 @@
+"""Collate/pad/loader tests."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graphs import GraphLoader, GraphSample, PadSpec, collate, compute_pad_spec
+
+
+def make_sample(n, e, fx=3, yg=2, yn=1, seed=0):
+    rng = np.random.default_rng(seed)
+    return GraphSample(
+        x=rng.normal(size=(n, fx)),
+        pos=rng.normal(size=(n, 3)),
+        senders=rng.integers(0, n, size=e),
+        receivers=rng.integers(0, n, size=e),
+        graph_y=rng.normal(size=(yg,)),
+        node_y=rng.normal(size=(n, yn)),
+    )
+
+
+def test_collate_shapes_and_masks():
+    samples = [make_sample(4, 7, seed=1), make_sample(6, 9, seed=2)]
+    pad = PadSpec(n_node=16, n_edge=32, n_graph=4)
+    b = collate(samples, pad)
+    assert b.x.shape == (16, 3)
+    assert b.senders.shape == (32,)
+    assert b.graph_y.shape == (4, 2)
+    assert b.node_mask.sum() == 10
+    assert b.edge_mask.sum() == 16
+    assert b.graph_mask.sum() == 2
+    # second sample's nodes shifted by first sample's node count
+    np.testing.assert_array_equal(b.batch[:4], 0)
+    np.testing.assert_array_equal(b.batch[4:10], 1)
+    # padding nodes assigned to dummy graph
+    np.testing.assert_array_equal(b.batch[10:], 3)
+    # padded edges point at last (padded) node
+    np.testing.assert_array_equal(b.senders[16:], 15)
+    assert b.n_node[0] == 4 and b.n_node[1] == 6
+
+
+def test_collate_overflow_raises():
+    samples = [make_sample(10, 5)]
+    with pytest.raises(ValueError):
+        collate(samples, PadSpec(n_node=8, n_edge=32, n_graph=2))
+    with pytest.raises(ValueError):
+        collate(samples, PadSpec(n_node=32, n_edge=4, n_graph=2))
+    with pytest.raises(ValueError):
+        collate(samples * 3, PadSpec(n_node=64, n_edge=64, n_graph=3))
+
+
+def test_compute_pad_spec_fits():
+    samples = [make_sample(5, 11, seed=i) for i in range(5)]
+    pad = compute_pad_spec(samples, batch_size=3)
+    b = collate(samples[:3], pad)
+    assert b.node_mask.sum() == 15
+
+
+def test_loader_epoch_determinism_and_sharding():
+    samples = [make_sample(4, 6, seed=i) for i in range(12)]
+    loader = GraphLoader(samples, batch_size=2, shuffle=True, seed=42)
+    loader.set_epoch(0)
+    first = [np.asarray(b.x).copy() for b in loader]
+    loader.set_epoch(0)
+    again = [np.asarray(b.x) for b in loader]
+    for a, c in zip(first, again):
+        np.testing.assert_array_equal(a, c)
+    loader.set_epoch(1)
+    shuffled = [np.asarray(b.x) for b in loader]
+    assert any(not np.array_equal(a, c) for a, c in zip(first, shuffled))
+
+    # rank sharding covers the dataset disjointly
+    l0 = GraphLoader(samples, batch_size=2, rank=0, world=2)
+    l1 = GraphLoader(samples, batch_size=2, rank=1, world=2)
+    assert len(l0) == len(l1) == 3
+    seen0 = set(l0._epoch_indices().tolist())
+    seen1 = set(l1._epoch_indices().tolist())
+    assert seen0 | seen1 == set(range(12))
+    assert seen0 & seen1 == set()
+
+
+def test_edge_vectors_with_shifts():
+    import jax.numpy as jnp
+
+    s = make_sample(3, 2)
+    s.senders = np.array([0, 1], np.int32)
+    s.receivers = np.array([1, 2], np.int32)
+    s.edge_shifts = np.array([[1.0, 0, 0], [0, 0, 0]], np.float32)
+    pad = PadSpec(8, 8, 2)
+    b = collate([s], pad)
+    vec = np.asarray(b.edge_vectors())
+    expected0 = s.pos[1] - s.pos[0] + np.array([1.0, 0, 0])
+    np.testing.assert_allclose(vec[0], expected0, rtol=1e-5)
+
+
+def test_collate_requires_reserved_padding_node():
+    # exactly filling the node slots must be rejected: padded edges wire to
+    # node n_node-1 which would then be a real node
+    s = make_sample(8, 2)
+    with pytest.raises(ValueError):
+        collate([s], PadSpec(n_node=8, n_edge=8, n_graph=2))
+    collate([s], PadSpec(n_node=9, n_edge=8, n_graph=2))  # one spare -> fine

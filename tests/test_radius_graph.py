@@ -1,0 +1,133 @@
+"""Radius graph correctness: brute-force parity, PBC images, max_neighbours.
+
+Mirrors the reference's PBC tests (`tests/test_periodic_boundary_conditions.py`,
+which compare against brute force with explicit images).
+"""
+
+import numpy as np
+
+from hydragnn_tpu.graphs.radius import radius_graph
+
+
+def brute_force_pbc(pos, radius, cell, pbc, n_images=3):
+    """Reference implementation: enumerate all images in a generous window."""
+    import itertools
+
+    n = len(pos)
+    edges = set()
+    rng = [range(-n_images, n_images + 1) if p else range(0, 1) for p in pbc]
+    for sh in itertools.product(*rng):
+        disp = np.asarray(sh, float) @ cell
+        for i in range(n):
+            for j in range(n):
+                d = np.linalg.norm(pos[j] + disp - pos[i])
+                if d <= radius and d > 1e-12:
+                    edges.add((i, j, sh))
+    return edges
+
+
+def test_open_space_matches_brute_force():
+    rng = np.random.default_rng(3)
+    pos = rng.uniform(0, 5, size=(40, 3))
+    s, r, shifts = radius_graph(pos, radius=1.5)
+    got = set(zip(s.tolist(), r.tolist()))
+    d = np.linalg.norm(pos[None] - pos[:, None], axis=-1)
+    expect = {(i, j) for i in range(40) for j in range(40) if i != j and d[i, j] <= 1.5}
+    assert got == expect
+    np.testing.assert_allclose(shifts, 0.0)
+
+
+def test_pbc_cubic_cell_matches_brute_force():
+    rng = np.random.default_rng(5)
+    cell = np.eye(3) * 3.0
+    pos = rng.uniform(0, 3.0, size=(12, 3))
+    pbc = np.array([True, True, True])
+    s, r, shifts = radius_graph(pos, radius=1.4, cell=cell, pbc=pbc)
+    # reconstruct integer shifts from cartesian ones
+    int_shifts = np.round(shifts @ np.linalg.inv(cell)).astype(int)
+    got = set(zip(s.tolist(), r.tolist(), map(tuple, int_shifts.tolist())))
+    expect = brute_force_pbc(pos, 1.4, cell, pbc)
+    assert got == expect
+    # distances all within cutoff
+    vec = pos[r] - pos[s] + shifts
+    assert np.all(np.linalg.norm(vec, axis=1) <= 1.4 + 1e-9)
+
+
+def test_mixed_pbc():
+    cell = np.eye(3) * 2.0
+    pos = np.array([[0.1, 1.0, 1.0], [1.9, 1.0, 1.0]])  # close across x boundary only
+    pbc = np.array([True, False, False])
+    s, r, shifts = radius_graph(pos, radius=0.5, cell=cell, pbc=pbc)
+    got = set(zip(s.tolist(), r.tolist()))
+    assert got == {(0, 1), (1, 0)}  # via image
+    assert np.all(np.abs(shifts[:, 0]) == 2.0)
+
+
+def test_triclinic_cell():
+    rng = np.random.default_rng(11)
+    cell = np.array([[3.0, 0, 0], [0.9, 2.8, 0], [0.4, 0.3, 3.1]])
+    frac = rng.uniform(0, 1, size=(10, 3))
+    pos = frac @ cell
+    pbc = np.array([True, True, True])
+    s, r, shifts = radius_graph(pos, radius=1.2, cell=cell, pbc=pbc)
+    int_shifts = np.round(shifts @ np.linalg.inv(cell)).astype(int)
+    got = set(zip(s.tolist(), r.tolist(), map(tuple, int_shifts.tolist())))
+    expect = brute_force_pbc(pos, 1.2, cell, pbc)
+    assert got == expect
+
+
+def test_max_neighbours_prunes_to_nearest():
+    # star: node 0 at origin, others on a line at increasing distance
+    pos = np.zeros((5, 3))
+    pos[1:, 0] = [1.0, 2.0, 3.0, 4.0]
+    s, r, shifts = radius_graph(pos, radius=10.0, max_neighbours=2)
+    incoming0 = s[r == 0]
+    assert set(incoming0.tolist()) == {1, 2}  # two nearest senders kept
+    # every node keeps at most 2 incoming edges
+    for node in range(5):
+        assert (r == node).sum() <= 2
+
+
+def test_periodic_self_edges():
+    # single atom in a small periodic box sees its own images
+    cell = np.eye(3) * 1.0
+    pos = np.array([[0.5, 0.5, 0.5]])
+    s, r, shifts = radius_graph(pos, radius=1.05, cell=cell, pbc=np.array([True] * 3))
+    assert len(s) == 6  # 6 nearest images
+    assert np.all(s == 0) and np.all(r == 0)
+    np.testing.assert_allclose(np.linalg.norm(shifts, axis=1), 1.0, rtol=1e-6)
+
+
+def test_triclinic_skewed_cell_wide_radius():
+    """Regression: plane spacings must come from reciprocal columns, not rows —
+    a skewed cell with radius near the spacing needs the 2nd image shell."""
+    cell = np.array([[3.0, 0, 0], [0.9, 2.8, 0], [0.4, 0.3, 3.1]])
+    frac = np.array([[0.99, 0.5, 0.3], [0.005, 0.164, 0.214]])
+    pos = frac @ cell
+    pbc = np.array([True, True, True])
+    s, r, shifts = radius_graph(pos, radius=2.95, cell=cell, pbc=pbc)
+    int_shifts = np.round(shifts @ np.linalg.inv(cell)).astype(int)
+    got = set(zip(s.tolist(), r.tolist(), map(tuple, int_shifts.tolist())))
+    expect = brute_force_pbc(pos, 2.95, cell, pbc, n_images=3)
+    assert got == expect
+    assert (0, 1, (2, 0, 0)) in got  # the shell the axis bug dropped
+
+
+def test_large_periodic_system_cell_list_path():
+    """PBC search must survive systems big enough to trigger grid binning."""
+    rng = np.random.default_rng(7)
+    cell = np.eye(3) * 20.0
+    pos = rng.uniform(0, 20.0, size=(900, 3))
+    pbc = np.array([True, True, True])
+    s, r, shifts = radius_graph(pos, radius=2.0, cell=cell, pbc=pbc)
+    vec = pos[r] - pos[s] + shifts
+    assert np.all(np.linalg.norm(vec, axis=1) <= 2.0 + 1e-9)
+    # spot check against brute force on a subsample of receivers
+    expect = brute_force_pbc(pos[:30], 2.0, cell, pbc, n_images=1)
+    int_shifts = np.round(shifts @ np.linalg.inv(cell)).astype(int)
+    got30 = {
+        (i, j, sh)
+        for i, j, sh in zip(s.tolist(), r.tolist(), map(tuple, int_shifts.tolist()))
+        if i < 30 and j < 30
+    }
+    assert got30 == expect

@@ -1,0 +1,82 @@
+"""Segment-op unit tests: parity with straightforward numpy reductions."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graphs import segment
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(10, 4)).astype(np.float32)
+    ids = np.array([0, 0, 1, 1, 1, 2, 2, 3, 3, 3], np.int32)
+    return jnp.asarray(vals), jnp.asarray(ids), 5  # segment 4 empty
+
+
+def test_segment_sum(data):
+    vals, ids, n = data
+    out = segment.segment_sum(vals, ids, n)
+    np_vals, np_ids = np.asarray(vals), np.asarray(ids)
+    for s in range(n):
+        expected = np_vals[np_ids == s].sum(axis=0) if (np_ids == s).any() else np.zeros(4)
+        np.testing.assert_allclose(out[s], expected, rtol=1e-5, atol=1e-6)
+
+
+def test_segment_mean(data):
+    vals, ids, n = data
+    out = segment.segment_mean(vals, ids, n)
+    np_vals, np_ids = np.asarray(vals), np.asarray(ids)
+    for s in range(4):
+        np.testing.assert_allclose(out[s], np_vals[np_ids == s].mean(axis=0), rtol=1e-5)
+    np.testing.assert_allclose(out[4], np.zeros(4), atol=1e-6)  # empty segment -> 0
+
+
+def test_segment_max_min_empty_are_zero(data):
+    vals, ids, n = data
+    mx = segment.segment_max(vals, ids, n)
+    mn = segment.segment_min(vals, ids, n)
+    np_vals, np_ids = np.asarray(vals), np.asarray(ids)
+    for s in range(4):
+        np.testing.assert_allclose(mx[s], np_vals[np_ids == s].max(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(mn[s], np_vals[np_ids == s].min(axis=0), rtol=1e-5)
+    assert np.all(np.isfinite(np.asarray(mx)))
+    np.testing.assert_allclose(mx[4], 0.0, atol=1e-6)
+    np.testing.assert_allclose(mn[4], 0.0, atol=1e-6)
+
+
+def test_segment_std(data):
+    vals, ids, n = data
+    out = segment.segment_std(vals, ids, n, eps=0.0)
+    np_vals, np_ids = np.asarray(vals), np.asarray(ids)
+    for s in range(4):
+        np.testing.assert_allclose(
+            out[s], np_vals[np_ids == s].std(axis=0), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_segment_softmax_sums_to_one(data):
+    vals, ids, n = data
+    w = segment.segment_softmax(vals[:, 0], ids, n)
+    sums = segment.segment_sum(w, ids, n)
+    np.testing.assert_allclose(np.asarray(sums)[:4], 1.0, rtol=1e-5)
+    assert np.all(np.isfinite(np.asarray(w)))
+
+
+def test_global_pool_dispatch(data):
+    vals, ids, n = data
+    for kind in ("add", "sum", "mean", "max", "min"):
+        out = segment.global_pool(kind, vals, ids, n)
+        assert out.shape == (n, 4)
+    with pytest.raises(ValueError):
+        segment.global_pool("median", vals, ids, n)
+
+
+def test_segment_max_min_integer_dtype_empty_is_zero():
+    vals = jnp.array([1, 2, 3], jnp.int32)
+    ids = jnp.array([0, 0, 1], jnp.int32)
+    mx = segment.segment_max(vals, ids, 3)
+    mn = segment.segment_min(vals, ids, 3)
+    np.testing.assert_array_equal(np.asarray(mx), [2, 3, 0])
+    np.testing.assert_array_equal(np.asarray(mn), [1, 3, 0])
