@@ -18,13 +18,18 @@ __version__ = "0.1.0"
 
 def __getattr__(name):
     # Lazy imports keep `import hydragnn_tpu` light and avoid importing jax
-    # model code before test harnesses set platform env vars.
+    # model code before test harnesses set platform env vars. Importing the
+    # submodule rebinds the package attribute to the *module*, so pin the
+    # function back into globals() to keep `hydragnn_tpu.run_training(...)`
+    # callable on every access.
     if name == "run_training":
-        from .run_training import run_training
+        from .run_training import run_training as fn
 
-        return run_training
+        globals()["run_training"] = fn
+        return fn
     if name == "run_prediction":
-        from .run_prediction import run_prediction
+        from .run_prediction import run_prediction as fn
 
-        return run_prediction
+        globals()["run_prediction"] = fn
+        return fn
     raise AttributeError(f"module 'hydragnn_tpu' has no attribute '{name}'")

@@ -1,0 +1,27 @@
+from .schema import (
+    ModelSpec,
+    HeadBranchSpec,
+    load_config,
+    merge_config,
+    update_config,
+    update_multibranch_heads,
+    get_log_name_config,
+    save_config,
+    ALL_MPNN_TYPES,
+    PNA_MODELS,
+    EDGE_MODELS,
+)
+
+__all__ = [
+    "ModelSpec",
+    "HeadBranchSpec",
+    "load_config",
+    "merge_config",
+    "update_config",
+    "update_multibranch_heads",
+    "get_log_name_config",
+    "save_config",
+    "ALL_MPNN_TYPES",
+    "PNA_MODELS",
+    "EDGE_MODELS",
+]

@@ -1,0 +1,409 @@
+"""Config system: the reference's JSON schema, validated and augmented.
+
+Keeps the ORNL/HydraGNN JSON config schema verbatim (sections ``Verbosity`` /
+``Dataset`` / ``NeuralNetwork.{Architecture,Variables_of_interest,Training}`` /
+``Visualization`` — see reference ``tests/inputs/ci.json`` and
+``README.md:140-195``) and reproduces the derivation rules of ``update_config``
+(reference ``hydragnn/utils/input_config_parsing/config_utils.py:26-163``):
+default filling, multibranch head normalization, output-dim extraction from
+data, PNA degree histograms, MACE average neighbor counts, edge-dim rules.
+
+On top of the raw dict (which remains the source of truth and what
+``save_config`` writes), ``ModelSpec.from_config`` extracts a frozen, typed
+view consumed by the model factory — the TPU build's replacement for threading
+a mutable dict through every constructor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from copy import deepcopy
+from typing import Any, Sequence
+
+import numpy as np
+
+# Architectures grouped by capability (reference ``config_utils.py:64,179-206``).
+PNA_MODELS = ("PNA", "PNAPlus", "PNAEq")
+EDGE_MODELS = (
+    "GAT", "PNA", "PNAPlus", "PAINN", "PNAEq", "CGCNN", "SchNet", "EGNN",
+    "DimeNet", "MACE",
+)
+ALL_MPNN_TYPES = (
+    "GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus", "SchNet",
+    "DimeNet", "EGNN", "PAINN", "PNAEq", "MACE",
+)
+
+# Architecture keys defaulted to None when absent (``config_utils.py:95-128``).
+_ARCH_NONE_DEFAULTS = (
+    "radius", "radial_type", "distance_transform", "num_gaussians",
+    "num_filters", "envelope_exponent", "num_after_skip", "num_before_skip",
+    "basis_emb_size", "int_emb_size", "out_emb_size", "num_radial",
+    "num_spherical", "correlation", "max_ell", "node_max_ell", "initial_bias",
+    "equivariance",
+)
+
+
+def load_config(source: str | dict) -> dict:
+    """Accept a JSON file path or an already-parsed dict (the reference's
+    ``run_training`` singledispatch, ``run_training.py:59-74``)."""
+    if isinstance(source, dict):
+        return deepcopy(source)
+    with open(source) as f:
+        return json.load(f)
+
+
+def merge_config(a: dict, b: dict) -> dict:
+    """Deep merge ``b`` over ``a`` (reference ``config_utils.py:388-396``)."""
+    result = deepcopy(a)
+    for bk, bv in b.items():
+        av = result.get(bk)
+        if isinstance(av, dict) and isinstance(bv, dict):
+            result[bk] = merge_config(av, bv)
+        else:
+            result[bk] = deepcopy(bv)
+    return result
+
+
+def update_multibranch_heads(output_heads: dict) -> dict:
+    """Normalize legacy single-branch head configs to the multibranch form
+    (reference ``utils/model/model.py:314-349``): each head family becomes a
+    list of ``{"type": "branch-N", "architecture": {...}}`` dicts."""
+    updated = dict(output_heads)
+    for name, val in output_heads.items():
+        if isinstance(val, list):
+            for branch in val:
+                if not (isinstance(branch, dict) and "type" in branch and "architecture" in branch):
+                    raise ValueError(
+                        f"output_heads['{name}'] does not contain proper branch config: {val}"
+                    )
+        elif isinstance(val, dict):
+            updated[name] = [{"type": "branch-0", "architecture": val}]
+        else:
+            raise ValueError("Unknown output_heads config!")
+    return updated
+
+
+def _degree_histogram(samples) -> list[int]:
+    """In-degree histogram over the training set — PNA's ``deg`` input
+    (reference ``gather_deg``, ``graph_samples_checks_and_updates.py:526-601``)."""
+    max_deg = 0
+    counts: dict[int, int] = {}
+    for s in samples:
+        deg = np.bincount(np.asarray(s.receivers), minlength=s.num_nodes)[: s.num_nodes]
+        for d in deg:
+            counts[int(d)] = counts.get(int(d), 0) + 1
+            max_deg = max(max_deg, int(d))
+    hist = [counts.get(d, 0) for d in range(max_deg + 1)]
+    return hist
+
+
+def _avg_num_neighbors(samples) -> float:
+    tot_edges = sum(s.num_edges for s in samples)
+    tot_nodes = sum(s.num_nodes for s in samples)
+    return float(tot_edges) / max(tot_nodes, 1)
+
+
+def update_config(config: dict, train_samples, val_samples=None, test_samples=None) -> dict:
+    """Fill defaults and derive data-dependent architecture fields.
+
+    Mirrors reference ``update_config`` (``config_utils.py:26-163``) with the
+    dataset represented as a sequence of ``GraphSample``s instead of torch
+    DataLoaders. The ``y_loc`` offset machinery is gone: targets are columnar
+    (see ``hydragnn_tpu.graphs.graph``), so output dims come straight from the
+    ``Dataset`` feature dims selected by ``output_index``.
+    """
+    config = deepcopy(config)
+    nn = config.setdefault("NeuralNetwork", {})
+    arch = nn.setdefault("Architecture", {})
+    voi = nn.setdefault("Variables_of_interest", {})
+    training = nn.setdefault("Training", {})
+
+    # --- GPS / encoding defaults (reference :40-48) ---
+    arch.setdefault("global_attn_engine", None)
+    arch.setdefault("global_attn_type", None)
+    arch.setdefault("global_attn_heads", 0)
+    arch.setdefault("pe_dim", 0)
+
+    # --- head normalization (reference :50-53) ---
+    arch["output_heads"] = update_multibranch_heads(arch.get("output_heads", {}))
+
+    # --- output dims/types (reference update_config_NN_outputs :227-268) ---
+    output_type = list(voi.get("type", []))
+    output_index = list(voi.get("output_index", []))
+    if "output_dim" in voi and voi["output_dim"]:
+        dims_list = list(voi["output_dim"])
+    else:
+        dims_list = []
+        for ihead, otype in enumerate(output_type):
+            feats = (
+                config["Dataset"]["graph_features"]
+                if otype == "graph"
+                else config["Dataset"]["node_features"]
+            )
+            dims_list.append(int(feats["dim"][output_index[ihead]]))
+    arch["output_dim"] = dims_list
+    arch["output_type"] = output_type
+    first = train_samples[0] if len(train_samples) else None
+    arch["num_nodes"] = int(first.num_nodes) if first is not None else None
+    graph_size_variable = len({s.num_nodes for s in train_samples}) > 1
+    env_var = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")
+    if env_var is not None:
+        graph_size_variable = bool(int(env_var))
+    arch["graph_size_variable"] = graph_size_variable
+    if graph_size_variable:
+        for branch in arch["output_heads"].get("node", []):
+            if branch["architecture"].get("type") == "mlp_per_node":
+                raise ValueError(
+                    '"mlp_per_node" is not allowed for variable graph size; use "mlp" or "conv"'
+                )
+
+    # --- input dim (reference :61-63) ---
+    arch["input_dim"] = len(voi.get("input_node_features", []))
+
+    # --- PNA degree histogram (reference :64-74) ---
+    if arch.get("mpnn_type") in PNA_MODELS:
+        if "pna_deg" not in arch or arch["pna_deg"] is None:
+            arch["pna_deg"] = _degree_histogram(train_samples)
+        arch["max_neighbours"] = len(arch["pna_deg"]) - 1
+    else:
+        arch.setdefault("pna_deg", None)
+
+    # --- CGCNN hidden dim rule (reference :76-83) ---
+    if arch.get("mpnn_type") == "CGCNN" and not arch.get("global_attn_engine"):
+        arch["hidden_dim"] = arch["input_dim"]
+
+    # --- MACE avg neighbors (reference :85-93) ---
+    if arch.get("mpnn_type") == "MACE":
+        if "avg_num_neighbors" not in arch or arch["avg_num_neighbors"] is None:
+            arch["avg_num_neighbors"] = _avg_num_neighbors(train_samples)
+    else:
+        arch.setdefault("avg_num_neighbors", None)
+
+    for key in _ARCH_NONE_DEFAULTS:
+        arch.setdefault(key, None)
+    arch.setdefault("enable_interatomic_potential", False)
+
+    # --- edge dim rules (reference update_config_edge_dim :179-206) ---
+    arch["edge_dim"] = None
+    if arch.get("edge_features"):
+        if arch["mpnn_type"] not in EDGE_MODELS:
+            raise ValueError(
+                f"Edge features can only be used with {', '.join(EDGE_MODELS)}."
+            )
+        if arch.get("enable_interatomic_potential"):
+            raise ValueError(
+                "Edge features cannot be used with interatomic potentials."
+            )
+        arch["edge_dim"] = len(arch["edge_features"])
+    elif arch.get("mpnn_type") == "CGCNN":
+        arch["edge_dim"] = 0
+
+    arch.setdefault("freeze_conv_layers", False)
+    arch.setdefault("activation_function", "relu")
+    arch.setdefault("SyncBatchNorm", False)
+    training.setdefault("conv_checkpointing", False)
+    training.setdefault("loss_function_type", "mse")
+    training.setdefault("precision", "fp32")
+    training.setdefault("batch_size", 32)
+    training.setdefault("Optimizer", {"type": "AdamW", "learning_rate": 1e-3})
+    voi.setdefault("denormalize_output", False)
+
+    return config
+
+
+def get_log_name_config(config: dict) -> str:
+    """Run-name string (reference ``config_utils.py:322-357``)."""
+    arch = config["NeuralNetwork"]["Architecture"]
+    training = config["NeuralNetwork"]["Training"]
+    name = config["Dataset"]["name"]
+    trimmed = name[: name.rfind("_")] if name.rfind("_") > 0 else name
+    return (
+        f"{arch['mpnn_type']}-r-{arch.get('radius')}-ncl-{arch['num_conv_layers']}"
+        f"-hd-{arch['hidden_dim']}-ne-{training['num_epoch']}"
+        f"-lr-{training['Optimizer']['learning_rate']}-bs-{training['batch_size']}"
+        f"-data-{trimmed}"
+        "-node_ft-"
+        + "".join(
+            str(x)
+            for x in config["NeuralNetwork"]["Variables_of_interest"]["input_node_features"]
+        )
+        + "-task_weights-"
+        + "".join(f"{w}-" for w in arch["task_weights"])
+    )
+
+
+def save_config(config: dict, log_name: str, path: str = "./logs/") -> None:
+    """Persist the augmented config next to the run logs (reference
+    ``config_utils.py:360-366``); caller gates on process index 0."""
+    fname = os.path.join(path, log_name, "config.json")
+    os.makedirs(os.path.dirname(fname), exist_ok=True)
+    with open(fname, "w") as f:
+        json.dump(config, f, indent=4)
+
+
+# ---------------------------------------------------------------------------
+# Typed view for the model factory
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class HeadBranchSpec:
+    branch: str  # "branch-0", "branch-1", ...
+    num_sharedlayers: int = 0
+    dim_sharedlayers: int = 0
+    num_headlayers: int = 1
+    dim_headlayers: tuple[int, ...] = ()
+    node_type: str | None = None  # "mlp" | "mlp_per_node" | "conv" for node heads
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """Everything the model factory needs, extracted from the augmented dict."""
+
+    mpnn_type: str
+    input_dim: int
+    hidden_dim: int
+    num_conv_layers: int
+    output_dim: tuple[int, ...]
+    output_type: tuple[str, ...]  # "graph" | "node" per head
+    graph_heads: tuple[HeadBranchSpec, ...]
+    node_heads: tuple[HeadBranchSpec, ...]
+    task_weights: tuple[float, ...]
+    activation: str = "relu"
+    loss_type: str = "mse"
+    graph_pooling: str = "mean"
+    dropout: float = 0.25
+    # geometry / radial
+    radius: float | None = None
+    max_neighbours: int | None = None
+    radial_type: str | None = None
+    num_gaussians: int | None = None
+    num_filters: int | None = None
+    num_radial: int | None = None
+    num_spherical: int | None = None
+    envelope_exponent: int | None = None
+    basis_emb_size: int | None = None
+    int_emb_size: int | None = None
+    out_emb_size: int | None = None
+    num_before_skip: int | None = None
+    num_after_skip: int | None = None
+    distance_transform: str | None = None
+    # equivariance / MACE
+    equivariance: bool | None = None
+    max_ell: int | None = None
+    node_max_ell: int | None = None
+    correlation: Any = None
+    avg_num_neighbors: float | None = None
+    # data-derived
+    pna_deg: tuple[int, ...] | None = None
+    num_nodes: int | None = None
+    edge_dim: int | None = None
+    # global attention
+    global_attn_engine: str | None = None
+    global_attn_type: str | None = None
+    global_attn_heads: int = 0
+    pe_dim: int = 0
+    # conditioning / misc
+    use_graph_attr_conditioning: bool = False
+    graph_attr_conditioning_mode: str = "concat_node"
+    enable_interatomic_potential: bool = False
+    freeze_conv_layers: bool = False
+    initial_bias: float | None = None
+    conv_checkpointing: bool = False
+    var_output: bool = False
+    graph_size_variable: bool = False
+
+    @property
+    def num_heads(self) -> int:
+        return len(self.output_dim)
+
+    @property
+    def num_branches(self) -> int:
+        return max(len(self.graph_heads), len(self.node_heads), 1)
+
+    @property
+    def graph_y_dim(self) -> int:
+        return sum(
+            (d * (2 if self.var_output else 1))
+            for d, t in zip(self.output_dim, self.output_type)
+            if t == "graph"
+        )
+
+    @staticmethod
+    def from_config(config: dict) -> "ModelSpec":
+        arch = config["NeuralNetwork"]["Architecture"]
+        training = config["NeuralNetwork"].get("Training", {})
+        heads_cfg = arch.get("output_heads", {})
+
+        def branches(family: str) -> tuple[HeadBranchSpec, ...]:
+            out = []
+            for b in heads_cfg.get(family, []):
+                a = b["architecture"]
+                dims = a.get("dim_headlayers", [])
+                out.append(
+                    HeadBranchSpec(
+                        branch=b["type"],
+                        num_sharedlayers=int(a.get("num_sharedlayers", 0)),
+                        dim_sharedlayers=int(a.get("dim_sharedlayers", 0)),
+                        num_headlayers=int(a.get("num_headlayers", len(dims))),
+                        dim_headlayers=tuple(int(d) for d in dims),
+                        node_type=a.get("type"),
+                    )
+                )
+            return tuple(out)
+
+        task_weights = arch.get("task_weights") or [1.0] * len(arch["output_dim"])
+        wsum = sum(abs(w) for w in task_weights)
+        task_weights = tuple(w / wsum for w in task_weights)  # Base.py:121-132
+
+        return ModelSpec(
+            mpnn_type=arch["mpnn_type"],
+            input_dim=int(arch["input_dim"]),
+            hidden_dim=int(arch["hidden_dim"]),
+            num_conv_layers=int(arch["num_conv_layers"]),
+            output_dim=tuple(int(d) for d in arch["output_dim"]),
+            output_type=tuple(arch["output_type"]),
+            graph_heads=branches("graph"),
+            node_heads=branches("node"),
+            task_weights=task_weights,
+            activation=arch.get("activation_function", "relu"),
+            loss_type=training.get("loss_function_type", "mse"),
+            graph_pooling=arch.get("graph_pooling", "mean"),
+            dropout=float(arch.get("dropout", 0.25)),
+            radius=arch.get("radius"),
+            max_neighbours=arch.get("max_neighbours"),
+            radial_type=arch.get("radial_type"),
+            num_gaussians=arch.get("num_gaussians"),
+            num_filters=arch.get("num_filters"),
+            num_radial=arch.get("num_radial"),
+            num_spherical=arch.get("num_spherical"),
+            envelope_exponent=arch.get("envelope_exponent"),
+            basis_emb_size=arch.get("basis_emb_size"),
+            int_emb_size=arch.get("int_emb_size"),
+            out_emb_size=arch.get("out_emb_size"),
+            num_before_skip=arch.get("num_before_skip"),
+            num_after_skip=arch.get("num_after_skip"),
+            distance_transform=arch.get("distance_transform"),
+            equivariance=arch.get("equivariance"),
+            max_ell=arch.get("max_ell"),
+            node_max_ell=arch.get("node_max_ell"),
+            correlation=arch.get("correlation"),
+            avg_num_neighbors=arch.get("avg_num_neighbors"),
+            pna_deg=tuple(arch["pna_deg"]) if arch.get("pna_deg") else None,
+            num_nodes=arch.get("num_nodes"),
+            edge_dim=arch.get("edge_dim"),
+            global_attn_engine=arch.get("global_attn_engine") or None,
+            global_attn_type=arch.get("global_attn_type") or None,
+            global_attn_heads=int(arch.get("global_attn_heads") or 0),
+            pe_dim=int(arch.get("pe_dim") or 0),
+            use_graph_attr_conditioning=bool(arch.get("use_graph_attr_conditioning", False)),
+            graph_attr_conditioning_mode=arch.get("graph_attr_conditioning_mode", "concat_node"),
+            enable_interatomic_potential=bool(arch.get("enable_interatomic_potential", False)),
+            freeze_conv_layers=bool(arch.get("freeze_conv_layers", False)),
+            initial_bias=arch.get("initial_bias"),
+            conv_checkpointing=bool(training.get("conv_checkpointing", False)),
+            var_output=training.get("loss_function_type") == "GaussianNLLLoss",
+            graph_size_variable=bool(arch.get("graph_size_variable", False)),
+        )

@@ -1,0 +1,14 @@
+from .synthetic import deterministic_graph_data
+
+
+def load_raw_dataset(config: dict):
+    """Dispatch on ``Dataset.format`` to a raw loader (reference
+    ``transform_raw_data_to_serialized`` + per-format loaders). Formats are
+    registered as the datasets package grows (LSMS/CFG/XYZ/pickle)."""
+    fmt = config["Dataset"].get("format")
+    raise ValueError(
+        f"Dataset format '{fmt}' has no registered loader yet; pass samples= directly"
+    )
+
+
+__all__ = ["deterministic_graph_data", "load_raw_dataset"]

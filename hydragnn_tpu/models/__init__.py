@@ -1,0 +1,17 @@
+from .base import HydraModel, CONV_REGISTRY, register_conv, head_columns
+from .create import create_model, create_model_config, init_model
+from .common import MLP, MaskedBatchNorm, get_activation, get_loss
+
+__all__ = [
+    "HydraModel",
+    "CONV_REGISTRY",
+    "register_conv",
+    "head_columns",
+    "create_model",
+    "create_model_config",
+    "init_model",
+    "MLP",
+    "MaskedBatchNorm",
+    "get_activation",
+    "get_loss",
+]

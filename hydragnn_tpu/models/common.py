@@ -1,0 +1,161 @@
+"""Shared model components: activations, losses, MLPs, masked batch norm.
+
+Mirrors reference ``hydragnn/utils/model/model.py:30-61`` (activation / loss
+selection) with jax-native implementations, plus the padding-aware BatchNorm
+that the TPU build needs (the reference uses plain ``BatchNorm1d`` because its
+batches are ragged-but-exact; ours carry padded node slots that must not
+contaminate the statistics).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_ACTIVATIONS: dict[str, Callable[[Array], Array]] = {
+    "relu": nn.relu,
+    "selu": nn.selu,
+    "prelu": lambda x: jnp.where(x >= 0, x, 0.25 * x),  # torch PReLU init slope
+    "elu": nn.elu,
+    "lrelu_01": lambda x: nn.leaky_relu(x, negative_slope=0.1),
+    "lrelu_025": lambda x: nn.leaky_relu(x, negative_slope=0.25),
+    "lrelu_05": lambda x: nn.leaky_relu(x, negative_slope=0.5),
+    "sigmoid": nn.sigmoid,
+    "gelu": nn.gelu,
+    "tanh": nn.tanh,
+    "silu": nn.silu,
+}
+
+
+def get_activation(name: str) -> Callable[[Array], Array]:
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation '{name}'; supported: {sorted(_ACTIVATIONS)}"
+        )
+
+
+def masked_mse(pred: Array, target: Array, mask: Array) -> Array:
+    """Mean squared error over real (mask=1) rows only."""
+    mask = mask.reshape(mask.shape[0], *([1] * (pred.ndim - 1)))
+    se = (pred - target) ** 2 * mask
+    n_real = jnp.maximum(mask.sum(), 1.0)
+    return se.sum() / (n_real * pred.shape[-1])
+
+
+def masked_mae(pred: Array, target: Array, mask: Array) -> Array:
+    mask = mask.reshape(mask.shape[0], *([1] * (pred.ndim - 1)))
+    ae = jnp.abs(pred - target) * mask
+    n_real = jnp.maximum(mask.sum(), 1.0)
+    return ae.sum() / (n_real * pred.shape[-1])
+
+
+def masked_rmse(pred: Array, target: Array, mask: Array) -> Array:
+    return jnp.sqrt(masked_mse(pred, target, mask) + 1e-16)
+
+
+def masked_gaussian_nll(pred: Array, target: Array, mask: Array, var: Array) -> Array:
+    """torch.nn.GaussianNLLLoss semantics: 0.5*(log(var) + (x-mu)^2/var),
+    var clamped below at eps, mean reduction over real rows."""
+    eps = 1e-6
+    var = jnp.maximum(var, eps)
+    mask = mask.reshape(mask.shape[0], *([1] * (pred.ndim - 1)))
+    nll = 0.5 * (jnp.log(var) + (pred - target) ** 2 / var) * mask
+    n_real = jnp.maximum(mask.sum(), 1.0)
+    return nll.sum() / (n_real * pred.shape[-1])
+
+
+_LOSSES = {
+    "mse": masked_mse,
+    "mae": masked_mae,
+    "rmse": masked_rmse,
+}
+
+
+def get_loss(name: str):
+    if name == "GaussianNLLLoss":
+        return masked_gaussian_nll
+    try:
+        return _LOSSES[name]
+    except KeyError:
+        raise ValueError(f"Unknown loss '{name}'; supported: {sorted(_LOSSES)} or GaussianNLLLoss")
+
+
+class MLP(nn.Module):
+    """Dense stack with activation between layers (last layer linear unless
+    ``act_last``)."""
+
+    features: Sequence[int]
+    activation: str = "relu"
+    act_last: bool = False
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        act = get_activation(self.activation)
+        n = len(self.features)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}")(x)
+            if i < n - 1 or self.act_last:
+                x = act(x)
+        return x
+
+
+class MaskedBatchNorm(nn.Module):
+    """BatchNorm over valid rows only (padding excluded from statistics).
+
+    Functional equivalent of the per-layer ``BatchNorm(hidden_dim)`` feature
+    layers in reference ``Base.py:446-463``; running stats live in the
+    ``batch_stats`` collection like flax's own BatchNorm. On multi-device
+    meshes, stats are synced across the ``axis_name`` axis when provided —
+    the analog of the reference's optional SyncBatchNorm
+    (``distributed.py:414-416``).
+    """
+
+    momentum: float = 0.9  # torch BatchNorm1d default (1 - torch's 0.1)
+    epsilon: float = 1e-5
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: Array, mask: Array, train: bool = False) -> Array:
+        features = x.shape[-1]
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+        scale = self.param("scale", nn.initializers.ones, (features,))
+        bias = self.param("bias", nn.initializers.zeros, (features,))
+
+        if train:
+            m = mask.reshape(-1, 1).astype(x.dtype)
+            count = jnp.maximum(m.sum(), 1.0)
+            mean = (x * m).sum(axis=0) / count
+            var = (((x - mean) ** 2) * m).sum(axis=0) / count
+            if self.axis_name is not None:
+                mean = jax.lax.pmean(mean, self.axis_name)
+                var = jax.lax.pmean(var, self.axis_name)
+            if not self.is_initializing():
+                ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+        else:
+            mean, var = ra_mean.value, ra_var.value
+
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        return y * scale + bias
+
+
+def local_node_index(batch_ids: Array, n_node: Array, num_nodes: int) -> Array:
+    """Position of each node within its own graph (0-based) — needed by the
+    ``mlp_per_node`` head type (reference ``MLPNode``, ``Base.py:912-982``).
+
+    Works because collate packs each graph's nodes contiguously.
+    """
+    offsets = jnp.concatenate([jnp.zeros((1,), n_node.dtype), jnp.cumsum(n_node)[:-1]])
+    return jnp.arange(num_nodes, dtype=batch_ids.dtype) - offsets[batch_ids]

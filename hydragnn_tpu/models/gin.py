@@ -1,0 +1,39 @@
+"""GIN — Graph Isomorphism Network conv stack.
+
+Capability parity with reference ``hydragnn/models/GINStack.py:21-49`` (PyG
+``GINConv`` with ``train_eps=True``): message = neighbor sum, update =
+MLP((1+eps) * h_i + sum_j h_j). Invariant-only; positions pass through
+untouched (reference returns ``equiv_node_feat`` unchanged).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from ..graphs.graph import GraphBatch
+from ..graphs import segment
+from .base import register_conv
+from .common import MLP
+
+
+@register_conv("GIN")
+class GINConv(nn.Module):
+    spec: ModelSpec
+    layer: int
+    out_dim: int | None = None
+
+    @nn.compact
+    def __call__(self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch):
+        hidden = self.out_dim or self.spec.hidden_dim
+        eps = self.param("eps", nn.initializers.zeros, ())
+        messages = inv[batch.senders] * batch.edge_mask[:, None]
+        agg = segment.segment_sum(messages, batch.receivers, batch.num_nodes)
+        out = MLP(
+            features=(hidden, hidden),
+            activation=self.spec.activation,
+            name="nn",
+        )((1.0 + eps) * inv + agg)
+        return out, equiv

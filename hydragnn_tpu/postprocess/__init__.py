@@ -1,0 +1,3 @@
+from .postprocess import output_denormalize
+
+__all__ = ["output_denormalize"]

@@ -1,0 +1,38 @@
+"""Output denormalization (reference ``hydragnn/postprocess/postprocess.py``):
+map min-max-normalized predictions/targets back to physical units using the
+per-feature minmax recorded by the data pipeline."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def output_denormalize(voi: dict, true_values, predicted_values, spec):
+    """``y = y_norm * (max - min) + min`` per head (reference
+    ``postprocess.py:13-54``). ``voi`` carries ``minmax_graph_feature`` /
+    ``minmax_node_feature`` as [2, F] arrays and ``output_index``/``type``."""
+    node_minmax = np.asarray(voi.get("minmax_node_feature", []))
+    graph_minmax = np.asarray(voi.get("minmax_graph_feature", []))
+    # node minmax columns are [input features..., node targets...] — targets
+    # start after the inputs (see preprocess.normalize_features)
+    node_target_dims = sum(
+        d for d, t in zip(spec.output_dim, spec.output_type) if t == "node"
+    )
+    x_dim = node_minmax.shape[1] - node_target_dims if node_minmax.size else 0
+    out_t, out_p = [], []
+    g_off = n_off = 0
+    for ihead, (otype, dim) in enumerate(zip(spec.output_type, spec.output_dim)):
+        if otype == "graph" and graph_minmax.size:
+            lo = graph_minmax[0, g_off : g_off + dim]
+            hi = graph_minmax[1, g_off : g_off + dim]
+            g_off += dim
+        elif otype == "node" and node_minmax.size:
+            lo = node_minmax[0, x_dim + n_off : x_dim + n_off + dim]
+            hi = node_minmax[1, x_dim + n_off : x_dim + n_off + dim]
+            n_off += dim
+        else:
+            lo, hi = 0.0, 1.0
+        rng = np.where(np.asarray(hi) - np.asarray(lo) < 1e-12, 1.0, np.asarray(hi) - np.asarray(lo))
+        out_t.append(true_values[ihead] * rng + lo)
+        out_p.append(predicted_values[ihead] * rng + lo)
+    return out_t, out_p

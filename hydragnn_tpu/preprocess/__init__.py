@@ -1,0 +1,15 @@
+from .load_data import (
+    apply_variables_of_interest,
+    split_dataset,
+    dataset_loading_and_splitting,
+    create_dataloaders,
+    normalize_features,
+)
+
+__all__ = [
+    "apply_variables_of_interest",
+    "split_dataset",
+    "dataset_loading_and_splitting",
+    "create_dataloaders",
+    "normalize_features",
+]

@@ -1,0 +1,181 @@
+"""Data pipeline: feature/target selection, splits, loader construction.
+
+Reference counterparts:
+* ``update_predicted_values`` + ``update_atom_features``
+  (``hydragnn/preprocess/graph_samples_checks_and_updates.py:604-659``) —
+  column-select inputs and build target layout. The reference concatenates
+  targets into ragged ``data.y`` with ``y_loc`` offsets; here targets become
+  columnar ``graph_y``/``node_y`` (static shapes — see graphs/graph.py).
+* ``split_dataset`` (``hydragnn/preprocess/load_data.py:337-357``) — random
+  split into train/val/test by ``perc_train``.
+* ``create_dataloaders`` (``load_data.py:226-334``) — per-process
+  DistributedSampler semantics via ``GraphLoader(rank, world)``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graphs.batching import GraphLoader, PadSpec, compute_pad_spec
+from ..graphs.graph import GraphSample
+
+
+def apply_variables_of_interest(samples, config: dict) -> list[GraphSample]:
+    """Select model inputs (``input_node_features``) and build columnar targets
+    from per-sample feature tables per ``Variables_of_interest``.
+
+    Each sample must carry ``extras['node_table']`` ([N, F_node]) and
+    ``extras['graph_table']`` ([F_graph]) — or already have x/graph_y/node_y
+    set, in which case it passes through untouched.
+    """
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    ds = config.get("Dataset", {})
+    input_cols = list(voi.get("input_node_features", []))
+    output_type = list(voi.get("type", []))
+    output_index = list(voi.get("output_index", []))
+
+    node_dims = ds.get("node_features", {}).get("dim", [])
+    node_cols = ds.get("node_features", {}).get("column_index", [])
+    graph_dims = ds.get("graph_features", {}).get("dim", [])
+    graph_cols = ds.get("graph_features", {}).get("column_index", [])
+
+    out = []
+    for s in samples:
+        node_table = s.extras.get("node_table")
+        graph_table = s.extras.get("graph_table")
+        if node_table is None:
+            out.append(s)
+            continue
+        node_table = np.asarray(node_table, np.float64)
+        graph_table = np.asarray(graph_table, np.float64).reshape(-1)
+
+        s.x = node_table[:, input_cols].astype(np.float32)
+
+        graph_targets = []
+        node_targets = []
+        for otype, oidx in zip(output_type, output_index):
+            if otype == "graph":
+                col = graph_cols[oidx] if graph_cols else oidx
+                dim = graph_dims[oidx] if graph_dims else 1
+                graph_targets.append(graph_table[col : col + dim])
+            elif otype == "node":
+                col = node_cols[oidx] if node_cols else oidx
+                dim = node_dims[oidx] if node_dims else 1
+                node_targets.append(node_table[:, col : col + dim])
+            else:
+                raise ValueError(f"Unknown output type '{otype}'")
+        s.graph_y = (
+            np.concatenate(graph_targets).astype(np.float32)
+            if graph_targets
+            else np.zeros((0,), np.float32)
+        )
+        s.node_y = (
+            np.concatenate(node_targets, axis=1).astype(np.float32)
+            if node_targets
+            else np.zeros((s.num_nodes, 0), np.float32)
+        )
+        out.append(s)
+    return out
+
+
+def normalize_features(samples) -> tuple[np.ndarray, np.ndarray]:
+    """Min-max normalize x / graph_y / node_y in place over the dataset
+    (the reference's raw-loader normalization, ``raw_dataset_loader.py``).
+    Returns (node_minmax, graph_minmax) for later denormalization."""
+    def _minmax(arrs):
+        lo = np.min([a.min(axis=0) for a in arrs if a.size], axis=0)
+        hi = np.max([a.max(axis=0) for a in arrs if a.size], axis=0)
+        rng = np.where(hi - lo < 1e-12, 1.0, hi - lo)
+        return lo, rng
+
+    xs = [s.x for s in samples]
+    lo_x, rng_x = _minmax(xs)
+    for s in samples:
+        s.x = ((s.x - lo_x) / rng_x).astype(np.float32)
+
+    if samples and samples[0].node_y.shape[1]:
+        lo_ny, rng_ny = _minmax([s.node_y for s in samples])
+        for s in samples:
+            s.node_y = ((s.node_y - lo_ny) / rng_ny).astype(np.float32)
+    else:
+        lo_ny = rng_ny = np.zeros((0,))
+    if samples and samples[0].graph_y.shape[0]:
+        gys = np.stack([s.graph_y for s in samples])
+        lo_gy = gys.min(axis=0)
+        rng_gy = np.where(gys.max(axis=0) - lo_gy < 1e-12, 1.0, gys.max(axis=0) - lo_gy)
+        for s in samples:
+            s.graph_y = ((s.graph_y - lo_gy) / rng_gy).astype(np.float32)
+    else:
+        lo_gy = rng_gy = np.zeros((0,))
+    node_minmax = np.stack([np.concatenate([lo_x, lo_ny]), np.concatenate([lo_x + rng_x, lo_ny + rng_ny])]) if lo_ny.size or lo_x.size else np.zeros((2, 0))
+    graph_minmax = np.stack([lo_gy, lo_gy + rng_gy]) if lo_gy.size else np.zeros((2, 0))
+    return node_minmax, graph_minmax
+
+
+def split_dataset(samples, perc_train: float, stratify_splitting: bool = False, seed: int = 0):
+    """Random train/val/test split: val and test each get (1-perc_train)/2
+    (reference ``load_data.py:337-357``)."""
+    n = len(samples)
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    n_train = int(n * perc_train)
+    n_val = int(n * (1.0 - perc_train) / 2.0)
+    train = [samples[i] for i in perm[:n_train]]
+    val = [samples[i] for i in perm[n_train : n_train + n_val]]
+    test = [samples[i] for i in perm[n_train + n_val :]]
+    return train, val, test
+
+
+def create_dataloaders(
+    trainset,
+    valset,
+    testset,
+    batch_size: int,
+    rank: int = 0,
+    world: int = 1,
+    pad: PadSpec | None = None,
+    seed: int = 0,
+):
+    """Three loaders with a shared pad bucket (so all splits compile to the
+    same program) and DistributedSampler semantics on the train split."""
+    all_samples = list(trainset) + list(valset) + list(testset)
+    # never let drop_last starve training: a dataset smaller than the batch
+    # still yields one (smaller) batch per epoch
+    batch_size = max(1, min(batch_size, len(trainset) // max(world, 1) or 1))
+    pad = pad or compute_pad_spec(all_samples, batch_size)
+    train_loader = GraphLoader(
+        trainset, batch_size, pad=pad, shuffle=True, seed=seed, rank=rank, world=world
+    )
+    val_loader = GraphLoader(valset, batch_size, pad=pad, drop_last=False, rank=rank, world=world)
+    test_loader = GraphLoader(testset, batch_size, pad=pad, drop_last=False, rank=rank, world=world)
+    return train_loader, val_loader, test_loader
+
+
+def dataset_loading_and_splitting(config: dict, samples=None, rank: int = 0, world: int = 1):
+    """Reference ``dataset_loading_and_splitting`` (``load_data.py:207-223``):
+    raw -> selected/normalized -> split -> loaders. ``samples`` may be supplied
+    directly (unit-test path); otherwise the ``Dataset.format`` dispatches to a
+    raw loader (LSMS/CFG/XYZ/pickle — built out in the datasets package)."""
+    if samples is None:
+        from ..datasets import load_raw_dataset
+
+        samples = load_raw_dataset(config)
+    training = config.setdefault("NeuralNetwork", {}).setdefault("Training", {})
+    samples = apply_variables_of_interest(samples, config)
+    if config["NeuralNetwork"]["Variables_of_interest"].get("denormalize_output") or config[
+        "Dataset"
+    ].get("normalize", True):
+        node_minmax, graph_minmax = normalize_features(samples)
+        config["NeuralNetwork"]["Variables_of_interest"]["minmax_node_feature"] = (
+            node_minmax.tolist()
+        )
+        config["NeuralNetwork"]["Variables_of_interest"]["minmax_graph_feature"] = (
+            graph_minmax.tolist()
+        )
+    train, val, test = split_dataset(
+        samples,
+        perc_train=float(training.get("perc_train", 0.7)),
+        stratify_splitting=config["Dataset"].get("compositional_stratified_splitting", False),
+    )
+    bs = int(training.get("batch_size", 32))
+    return create_dataloaders(train, val, test, bs, rank=rank, world=world)

@@ -1,0 +1,78 @@
+"""``run_prediction`` — inference entry point (reference
+``hydragnn/run_prediction.py:34-114``): same data prologue, then runs the test
+split and returns ``(error, per-task losses, true values, predictions)`` with
+optional min-max denormalization (reference ``postprocess/postprocess.py:13``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import load_config, update_config
+from .models.base import head_columns
+from .models.create import create_model_config
+from .preprocess.load_data import dataset_loading_and_splitting
+from .train.step import TrainState, make_predict_step, resolve_precision
+
+
+def run_prediction(config_source, state: TrainState, model=None, samples: Sequence | None = None):
+    config = load_config(config_source)
+    train_loader, val_loader, test_loader = dataset_loading_and_splitting(
+        config, samples=samples
+    )
+    config = update_config(config, train_loader.samples, val_loader.samples, test_loader.samples)
+    if model is None:
+        model = create_model_config(config)
+
+    precision = resolve_precision(
+        config["NeuralNetwork"]["Training"].get("precision", "fp32")
+    )
+    predict_step = make_predict_step(model, compute_dtype=precision)
+
+    # ONE pass over the test split: gather per-head true/pred arrays
+    # (reference ``test()`` collection + gather,
+    # train_validate_test.py:989-1080); loss/RMSE are computed from the
+    # gathered arrays below instead of a second forward pass.
+    cols = head_columns(model.spec)
+    trues = [[] for _ in cols]
+    preds = [[] for _ in cols]
+    for batch in test_loader:
+        batch = jax.tree.map(jnp.asarray, batch)
+        out = predict_step(state, batch)
+        if model.spec.var_output:
+            out = out[0]
+        for ihead, (kind, col, dim) in enumerate(cols):
+            if kind == "graph":
+                mask = np.asarray(batch.graph_mask) > 0
+                trues[ihead].append(np.asarray(batch.graph_y[:, col : col + dim])[mask])
+                preds[ihead].append(np.asarray(out[ihead])[mask])
+            else:
+                mask = np.asarray(batch.node_mask) > 0
+                trues[ihead].append(np.asarray(batch.node_y[:, col : col + dim])[mask])
+                preds[ihead].append(np.asarray(out[ihead])[mask])
+    true_values = [np.concatenate(t) for t in trues]
+    predicted_values = [np.concatenate(p) for p in preds]
+
+    # per-task losses + weighted total from the gathered arrays
+    spec = model.spec
+    tasks_loss = [
+        float(np.mean((t - p) ** 2)) for t, p in zip(true_values, predicted_values)
+    ]
+    error = float(sum(w * l for w, l in zip(spec.task_weights, tasks_loss)))
+
+    voi = config["NeuralNetwork"]["Variables_of_interest"]
+    if voi.get("denormalize_output"):
+        from .postprocess.postprocess import output_denormalize
+
+        true_values, predicted_values = output_denormalize(
+            voi, true_values, predicted_values, model.spec
+        )
+
+    return error, tasks_loss, true_values, predicted_values
+
+
+__all__ = ["run_prediction"]

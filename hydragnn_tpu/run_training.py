@@ -1,0 +1,65 @@
+"""``run_training`` — the canonical entry point (reference
+``hydragnn/run_training.py:59-211``).
+
+Accepts a JSON config path or dict (the reference's singledispatch), plus an
+optional in-memory dataset (list of ``GraphSample``). Returns the final
+``TrainState`` together with the model and augmented config so callers
+(tests, HPO drivers) can keep going without re-loading checkpoints.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .config import ModelSpec, get_log_name_config, load_config, save_config, update_config
+from .models.create import create_model_config
+from .preprocess.load_data import apply_variables_of_interest, dataset_loading_and_splitting
+from .train.loop import train_validate_test
+from .train.optimizer import select_optimizer
+from .train.step import create_train_state, resolve_precision
+from .utils import tracer as tr
+from .utils.print_utils import print_distributed, setup_log
+
+
+def run_training(config_source, samples: Sequence | None = None, rank: int = 0, world: int = 1):
+    config = load_config(config_source)
+    verbosity = config.get("Verbosity", {}).get("level", 0)
+
+    # data loading + split (reference :90)
+    train_loader, val_loader, test_loader = dataset_loading_and_splitting(
+        config, samples=samples, rank=rank, world=world
+    )
+
+    # config augmentation from data (reference :92)
+    config = update_config(config, train_loader.samples, val_loader.samples, test_loader.samples)
+
+    log_name = get_log_name_config(config)
+    setup_log(log_name)
+    try:
+        save_config(config, log_name)
+    except OSError:
+        pass
+
+    # model + optimizer (reference :97-121)
+    model = create_model_config(config)
+    optimizer = select_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    example = next(iter(train_loader))
+    state = create_train_state(model, optimizer, example)
+
+    state = train_validate_test(
+        model,
+        optimizer,
+        state,
+        train_loader,
+        val_loader,
+        test_loader,
+        config["NeuralNetwork"],
+        log_name,
+        verbosity,
+    )
+
+    tr.print_timers(verbosity)
+    return state, model, config
+
+
+__all__ = ["run_training"]

@@ -1,0 +1,32 @@
+from .step import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_eval_step,
+    make_predict_step,
+    resolve_precision,
+)
+from .optimizer import select_optimizer, ReduceLROnPlateau, get_learning_rate, set_learning_rate
+from .loop import train_validate_test, train_epoch, evaluate, test
+from .checkpoint import save_checkpoint, load_checkpoint, Checkpoint, EarlyStopping
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_eval_step",
+    "make_predict_step",
+    "resolve_precision",
+    "select_optimizer",
+    "ReduceLROnPlateau",
+    "get_learning_rate",
+    "set_learning_rate",
+    "train_validate_test",
+    "train_epoch",
+    "evaluate",
+    "test",
+    "save_checkpoint",
+    "load_checkpoint",
+    "Checkpoint",
+    "EarlyStopping",
+]

@@ -1,0 +1,112 @@
+"""Checkpoint / resume + early stopping.
+
+Reference semantics (``hydragnn/utils/model/model.py:104-311, 513-571``):
+best-model checkpointing on validation-loss improvement after a warmup epoch
+count, per-epoch files with a symlink to the latest, resume via
+``Training.continue``/``startfrom``, and patience-based EarlyStopping. Here a
+checkpoint is an orbax-saved pytree {params, batch_stats, opt_state, step} —
+sharded-array-aware, so the same path works under pjit — plus a small JSON
+sidecar with scheduler/epoch metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from .step import TrainState
+
+
+def _ckpt_dir(log_name: str, path: str = "./logs/") -> str:
+    return os.path.abspath(os.path.join(path, log_name, "checkpoints"))
+
+
+def save_checkpoint(
+    state: TrainState,
+    log_name: str,
+    epoch: int,
+    path: str = "./logs/",
+    meta: dict | None = None,
+) -> str:
+    """Write epoch checkpoint and update the 'latest' pointer (the reference's
+    per-epoch files + symlink scheme, ``model.py:160-188``)."""
+    base = _ckpt_dir(log_name, path)
+    os.makedirs(base, exist_ok=True)
+    ckpt_path = os.path.join(base, f"epoch_{epoch}")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(ckpt_path, state, force=True)
+    with open(os.path.join(base, f"epoch_{epoch}.meta.json"), "w") as f:
+        json.dump({"epoch": epoch, **(meta or {})}, f)
+    latest = os.path.join(base, "latest")
+    if os.path.islink(latest) or os.path.exists(latest):
+        os.remove(latest)
+    os.symlink(ckpt_path, latest)
+    return ckpt_path
+
+
+def load_checkpoint(
+    template: TrainState, log_name: str, path: str = "./logs/", epoch: int | None = None
+) -> tuple[TrainState, dict]:
+    """Restore a checkpoint into the structure of ``template``."""
+    base = _ckpt_dir(log_name, path)
+    ckpt_path = (
+        os.path.join(base, f"epoch_{epoch}") if epoch is not None else os.path.join(base, "latest")
+    )
+    ckpt_path = os.path.realpath(ckpt_path)
+    with ocp.StandardCheckpointer() as ckptr:
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        state = ckptr.restore(ckpt_path, abstract)
+    meta_file = ckpt_path + ".meta.json"
+    meta = {}
+    if os.path.exists(meta_file):
+        with open(meta_file) as f:
+            meta = json.load(f)
+    return state, meta
+
+
+class Checkpoint:
+    """Best-val-loss checkpointing with warmup (reference ``model.py:531-553``)."""
+
+    def __init__(self, log_name: str, warmup: int = 0, path: str = "./logs/"):
+        self.log_name = log_name
+        self.warmup = warmup
+        self.path = path
+        self.best = float("inf")
+        self.best_epoch: int | None = None
+
+    def __call__(self, state: TrainState, epoch: int, val_loss: float, meta=None) -> bool:
+        if epoch < self.warmup or val_loss >= self.best:
+            return False
+        self.best = val_loss
+        self.best_epoch = epoch
+        save_checkpoint(
+            state, self.log_name, epoch, self.path, meta={"val_loss": val_loss, **(meta or {})}
+        )
+        return True
+
+
+class EarlyStopping:
+    """Patience-based early stop on validation loss (reference
+    ``model.py:556-571``)."""
+
+    def __init__(self, patience: int = 10, min_delta: float = 0.0):
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = float("inf")
+        self.count = 0
+        self.early_stop = False
+
+    def __call__(self, val_loss: float) -> bool:
+        if val_loss < self.best - self.min_delta:
+            self.best = val_loss
+            self.count = 0
+        else:
+            self.count += 1
+            if self.count >= self.patience:
+                self.early_stop = True
+        return self.early_stop

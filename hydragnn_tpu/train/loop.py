@@ -1,0 +1,175 @@
+"""The epoch loop: train / validate / test orchestration.
+
+Reference: ``hydragnn/train/train_validate_test.py:185-491`` (epoch loop with
+per-epoch sampler reshuffle, scheduler.step(val_loss), best-checkpoint,
+early stopping, walltime guard, span tracing) and ``:629-1090`` (the per-split
+loops). The per-batch mechanics live in ``step.py`` as one jitted program;
+this module is pure host-side orchestration.
+
+Env knobs honored for parity: ``HYDRAGNN_VALTEST=0`` skips val/test
+(``:343``), ``HYDRAGNN_MAX_NUM_BATCH`` caps batches/epoch (``:179-181``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graphs.batching import GraphLoader
+from ..models.base import HydraModel
+from ..utils.print_utils import print_distributed, iterate_tqdm
+from ..utils import tracer as tr
+from .checkpoint import Checkpoint, EarlyStopping
+from .optimizer import ReduceLROnPlateau, get_learning_rate, set_learning_rate
+from .step import TrainState, make_eval_step, make_train_step, resolve_precision
+
+
+def _max_num_batches(loader) -> int:
+    n = len(loader)
+    cap = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
+    if cap is not None:
+        n = min(n, int(cap))
+    return n
+
+
+def train_epoch(train_step, state: TrainState, loader, verbosity: int = 0):
+    """One training epoch; returns (state, mean loss, per-task mean losses)."""
+    tot = 0.0
+    tasks = None
+    n_graphs = 0.0
+    nbatch = _max_num_batches(loader)
+    tr.start("train")
+    for ib, batch in enumerate(iterate_tqdm(loader, verbosity, desc="train", total=nbatch)):
+        if ib >= nbatch:
+            break
+        batch = jax.tree.map(jnp.asarray, batch)
+        state, metrics = train_step(state, batch)
+        # loss accumulated weighted by real graph count (reference :795-799)
+        g = float(metrics["num_graphs"])
+        tot += float(metrics["loss"]) * g
+        t = np.asarray(metrics["tasks_loss"], np.float64) * g
+        tasks = t if tasks is None else tasks + t
+        n_graphs += g
+    tr.stop("train")
+    denom = max(n_graphs, 1.0)
+    return state, tot / denom, (tasks / denom if tasks is not None else np.zeros(0))
+
+
+def evaluate(eval_step, state: TrainState, loader, verbosity: int = 0, span: str = "validate"):
+    """Full-split evaluation; returns (loss, per-task losses, per-head rmse)."""
+    tot = 0.0
+    tasks = None
+    sse = None
+    count = None
+    n_graphs = 0.0
+    tr.start(span)
+    for batch in iterate_tqdm(loader, verbosity, desc=span, total=len(loader)):
+        batch = jax.tree.map(jnp.asarray, batch)
+        metrics = eval_step(state, batch)
+        g = float(metrics["num_graphs"])
+        tot += float(metrics["loss"]) * g
+        t = np.asarray(metrics["tasks_loss"], np.float64) * g
+        s = np.asarray(metrics["head_sse"], np.float64)
+        c = np.asarray(metrics["head_count"], np.float64)
+        tasks = t if tasks is None else tasks + t
+        sse = s if sse is None else sse + s
+        count = c if count is None else count + c
+        n_graphs += g
+    tr.stop(span)
+    denom = max(n_graphs, 1.0)
+    rmse = (
+        np.sqrt(sse / np.maximum(count, 1.0)) if sse is not None else np.zeros(0)
+    )
+    return (
+        tot / denom,
+        (tasks / denom if tasks is not None else np.zeros(0)),
+        rmse,
+    )
+
+
+def train_validate_test(
+    model: HydraModel,
+    optimizer,
+    state: TrainState,
+    train_loader: GraphLoader,
+    val_loader: GraphLoader,
+    test_loader: GraphLoader,
+    config_nn: dict,
+    log_name: str,
+    verbosity: int = 0,
+    writer=None,
+    walltime_check=None,
+) -> TrainState:
+    """The epoch loop. ``config_nn`` is the ``NeuralNetwork`` config section."""
+    training = config_nn["Training"]
+    num_epoch = int(training["num_epoch"])
+    precision = resolve_precision(training.get("precision", "fp32"))
+
+    train_step = make_train_step(model, optimizer, compute_dtype=precision)
+    eval_step = make_eval_step(model, compute_dtype=precision)
+
+    scheduler = ReduceLROnPlateau(get_learning_rate(state.opt_state))
+    checkpoint = (
+        Checkpoint(log_name, warmup=int(training.get("checkpoint_warmup", 0)))
+        if training.get("Checkpoint", False)
+        else None
+    )
+    early_stopping = (
+        EarlyStopping(patience=int(training.get("patience", 10)))
+        if training.get("EarlyStopping", False)
+        else None
+    )
+    skip_valtest = os.getenv("HYDRAGNN_VALTEST", "1") == "0"
+
+    for epoch in range(num_epoch):
+        train_loader.set_epoch(epoch)
+        state, train_loss, train_tasks = train_epoch(train_step, state, train_loader, verbosity)
+
+        if skip_valtest:
+            print_distributed(
+                verbosity, f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}"
+            )
+            continue
+
+        val_loss, val_tasks, _ = evaluate(eval_step, state, val_loader, verbosity, "validate")
+        test_loss, test_tasks, test_rmse = evaluate(
+            eval_step, state, test_loader, verbosity, "test"
+        )
+
+        new_lr = scheduler.step(val_loss)
+        if new_lr != get_learning_rate(state.opt_state):
+            state = state._replace(opt_state=set_learning_rate(state.opt_state, new_lr))
+
+        print_distributed(
+            verbosity,
+            f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}, "
+            f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}, LR: {new_lr:.2e}",
+        )
+        if writer is not None:
+            writer.add_scalar("train error", train_loss, epoch)
+            writer.add_scalar("validate error", val_loss, epoch)
+            writer.add_scalar("test error", test_loss, epoch)
+            for itask, tl in enumerate(train_tasks):
+                writer.add_scalar(f"train error of task {itask}", float(tl), epoch)
+
+        if checkpoint is not None:
+            checkpoint(state, epoch, val_loss)
+        if early_stopping is not None and early_stopping(val_loss):
+            print_distributed(verbosity, f"Early stopping at epoch {epoch}")
+            break
+        if walltime_check is not None and walltime_check():
+            print_distributed(verbosity, f"Walltime guard tripped at epoch {epoch}")
+            break
+
+    return state
+
+
+def test(eval_step, state: TrainState, loader, verbosity: int = 0):
+    """Reference ``test()`` (``train_validate_test.py:875-1090``): returns
+    (total error, per-task losses, per-head rmse)."""
+    return evaluate(eval_step, state, loader, verbosity, span="test")

@@ -1,0 +1,3 @@
+from . import print_utils, tracer
+
+__all__ = ["print_utils", "tracer"]

@@ -1,0 +1,62 @@
+"""Verbosity-gated printing + logging (reference
+``hydragnn/utils/print/print_utils.py``).
+
+Verbosity levels 0-4; ``print_distributed`` only prints on process index 0,
+like the reference's rank-0 gating.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def print_master(*args, **kwargs):
+    if _process_index() == 0:
+        print(*args, **kwargs)
+
+
+def print_distributed(verbosity_level: int, *args, **kwargs):
+    """Print on process 0 when verbosity >= 1... the reference prints at all
+    levels via print_master; keep the gate permissive (>=0)."""
+    if _process_index() == 0:
+        print(*args, **kwargs)
+
+
+def iterate_tqdm(iterable, verbosity_level: int, desc: str = "", total=None):
+    """Progress-bar iteration at verbosity >= 2 (reference ``iterate_tqdm``);
+    falls back to the plain iterable (tqdm may not be installed)."""
+    if verbosity_level >= 2 and _process_index() == 0:
+        try:
+            from tqdm import tqdm
+
+            return tqdm(iterable, desc=desc, total=total)
+        except ImportError:
+            pass
+    return iterable
+
+
+def setup_log(log_name: str, path: str = "./logs/") -> logging.Logger:
+    """Rank-tagged file logger at ``./logs/<run>/run.log`` (reference
+    ``print_utils.py:62-111``)."""
+    run_dir = os.path.join(path, log_name)
+    os.makedirs(run_dir, exist_ok=True)
+    logger = logging.getLogger(f"hydragnn_tpu.{log_name}")
+    logger.setLevel(logging.INFO)
+    if not logger.handlers:
+        fh = logging.FileHandler(os.path.join(run_dir, "run.log"))
+        fh.setFormatter(
+            logging.Formatter(f"%(asctime)s [p{_process_index()}] %(message)s")
+        )
+        logger.addHandler(fh)
+    return logger

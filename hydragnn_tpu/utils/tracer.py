@@ -1,0 +1,132 @@
+"""Span timers + profiling hooks.
+
+Reference: ``hydragnn/utils/profiling_and_tracing/tracer.py`` — a plugin
+registry of tracers (GPTL region timers, Score-P, NVML/ROCm/XPU energy
+counters) with ``tr.start/stop(name)`` spans hard-wired around the train loop.
+
+TPU equivalent: a lightweight hierarchical host timer keeping the reference's
+span names (dataload/forward/backward/opt_step/train/validate/test), plus an
+optional ``jax.profiler`` trace directory for XLA/perfetto dumps. Device-side
+timing is meaningless per-span under async dispatch — callers that need exact
+device timing should block on results; the ``train`` span brackets whole
+epochs, which *is* accurate because the loop syncs on metrics each batch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from collections import defaultdict
+
+
+class Timer:
+    __slots__ = ("count", "total", "t0", "running")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.t0 = 0.0
+        self.running = False
+
+    def start(self):
+        if not self.running:
+            self.t0 = time.perf_counter()
+            self.running = True
+
+    def stop(self):
+        if self.running:
+            self.total += time.perf_counter() - self.t0
+            self.count += 1
+            self.running = False
+
+
+_timers: dict[str, Timer] = defaultdict(Timer)
+_jax_trace_dir: str | None = None
+
+
+def initialize(trace_dir: str | None = None, enable_jax_profiler: bool = False):
+    """Optionally arm jax.profiler tracing (XLA + host, perfetto-viewable)."""
+    global _jax_trace_dir
+    if enable_jax_profiler and trace_dir:
+        _jax_trace_dir = trace_dir
+        os.makedirs(trace_dir, exist_ok=True)
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+
+
+def start(name: str, **_ignored):
+    _timers[name].start()
+
+
+def stop(name: str, **_ignored):
+    _timers[name].stop()
+
+
+@contextlib.contextmanager
+def span(name: str):
+    start(name)
+    try:
+        yield
+    finally:
+        stop(name)
+
+
+def profile(name: str):
+    """Decorator wrapping a function in a span (reference ``@tr.profile``)."""
+
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            with span(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def reset():
+    _timers.clear()
+
+
+def get(name: str) -> Timer:
+    return _timers[name]
+
+
+def summary() -> dict[str, dict]:
+    return {
+        k: {"count": t.count, "total_s": t.total, "avg_s": t.total / max(t.count, 1)}
+        for k, t in sorted(_timers.items())
+    }
+
+
+def save(path: str = "./logs/", prefix: str = "timing"):
+    """Dump per-process timing json (the reference writes ``gp_timing.p{rank}``,
+    ``tracer.py:432-458``)."""
+    global _jax_trace_dir
+    if _jax_trace_dir is not None:
+        import jax
+
+        jax.profiler.stop_trace()
+        _jax_trace_dir = None
+    try:
+        import jax
+
+        pid = jax.process_index()
+    except Exception:
+        pid = 0
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, f"{prefix}.p{pid}.json"), "w") as f:
+        json.dump(summary(), f, indent=2)
+
+
+def print_timers(verbosity_level: int = 0):
+    from .print_utils import print_master
+
+    for name, stats in summary().items():
+        print_master(
+            f"[timer] {name}: total {stats['total_s']:.3f}s over {stats['count']} calls "
+            f"(avg {stats['avg_s'] * 1e3:.2f} ms)"
+        )

@@ -1,16 +1,24 @@
-"""Test harness: force CPU with 8 virtual devices BEFORE jax initializes.
+"""Test harness: force CPU with 8 virtual devices BEFORE jax backends init.
 
 Mirrors the reference's CI strategy (oversubscribed `mpirun -n 2` ranks on one
 machine, `.github/workflows/CI.yml:53-67`) the JAX way: a virtual 8-device CPU
 platform lets every sharding/pjit test exercise real multi-device program
 partitioning without TPU hardware.
+
+Note: the machine's TPU plugin (axon) registers itself in ``sitecustomize``
+and calls ``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter
+start — env vars alone cannot override it; the config must be updated again
+here, before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-# Keep test compile times sane on the 1-core CI box.
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert not jax._src.xla_bridge._backends, "jax backends initialized before conftest"

@@ -1,0 +1,122 @@
+"""Config system tests (reference tests/test_config.py scope + our ModelSpec)."""
+
+import copy
+import json
+import os
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import (
+    ModelSpec,
+    merge_config,
+    update_config,
+    update_multibranch_heads,
+)
+from hydragnn_tpu.datasets import deterministic_graph_data
+
+CI_CONFIG = {
+    "Verbosity": {"level": 0},
+    "Dataset": {
+        "name": "unit_test_singlehead",
+        "format": "unit_test",
+        "node_features": {
+            "name": ["type", "x", "x2", "x3"],
+            "dim": [1, 1, 1, 1],
+            "column_index": [0, 1, 2, 3],
+        },
+        "graph_features": {"name": ["sum"], "dim": [1], "column_index": [0]},
+    },
+    "NeuralNetwork": {
+        "Architecture": {
+            "mpnn_type": "GIN",
+            "radius": 2.0,
+            "max_neighbours": 100,
+            "hidden_dim": 8,
+            "num_conv_layers": 2,
+            "output_heads": {
+                "graph": {
+                    "num_sharedlayers": 2,
+                    "dim_sharedlayers": 4,
+                    "num_headlayers": 2,
+                    "dim_headlayers": [10, 10],
+                }
+            },
+            "task_weights": [1.0],
+        },
+        "Variables_of_interest": {
+            "input_node_features": [0],
+            "output_names": ["sum"],
+            "output_index": [0],
+            "type": ["graph"],
+            "denormalize_output": False,
+        },
+        "Training": {
+            "num_epoch": 5,
+            "perc_train": 0.7,
+            "loss_function_type": "mse",
+            "batch_size": 16,
+            "Optimizer": {"type": "AdamW", "learning_rate": 0.02},
+        },
+    },
+}
+
+
+@pytest.fixture(scope="module")
+def samples():
+    return deterministic_graph_data(number_configurations=20, seed=1)
+
+
+def test_update_config_derivations(samples):
+    cfg = update_config(copy.deepcopy(CI_CONFIG), samples)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    assert arch["input_dim"] == 1
+    assert arch["output_dim"] == [1]
+    assert arch["output_type"] == ["graph"]
+    assert arch["pna_deg"] is None
+    assert arch["edge_dim"] is None
+    assert arch["graph_size_variable"] is True
+    # legacy head config normalized to branch form
+    assert arch["output_heads"]["graph"][0]["type"] == "branch-0"
+    assert arch["activation_function"] == "relu"
+
+
+def test_update_config_pna_degree(samples):
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["mpnn_type"] = "PNA"
+    cfg = update_config(cfg, samples)
+    deg = cfg["NeuralNetwork"]["Architecture"]["pna_deg"]
+    assert isinstance(deg, list) and sum(deg) == sum(s.num_nodes for s in samples)
+    assert cfg["NeuralNetwork"]["Architecture"]["max_neighbours"] == len(deg) - 1
+
+
+def test_model_spec_from_config(samples):
+    cfg = update_config(copy.deepcopy(CI_CONFIG), samples)
+    spec = ModelSpec.from_config(cfg)
+    assert spec.mpnn_type == "GIN"
+    assert spec.num_heads == 1
+    assert spec.graph_heads[0].dim_sharedlayers == 4
+    assert spec.task_weights == (1.0,)
+    assert spec.num_branches == 1
+
+
+def test_merge_config():
+    a = {"x": {"y": 1, "z": 2}, "w": 3}
+    b = {"x": {"y": 10}}
+    m = merge_config(a, b)
+    assert m == {"x": {"y": 10, "z": 2}, "w": 3}
+    assert a["x"]["y"] == 1  # no mutation
+
+
+def test_update_multibranch_heads_rejects_garbage():
+    with pytest.raises(ValueError):
+        update_multibranch_heads({"graph": [1, 2]})
+    with pytest.raises(ValueError):
+        update_multibranch_heads({"graph": "nope"})
+
+
+def test_edge_features_validation(samples):
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["edge_features"] = ["length"]
+    with pytest.raises(ValueError):  # GIN not an edge model
+        update_config(cfg, samples)

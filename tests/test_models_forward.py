@@ -1,0 +1,205 @@
+"""Model forward/loss shape tests across head configurations."""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import ModelSpec, update_config
+from hydragnn_tpu.datasets import deterministic_graph_data
+from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+from hydragnn_tpu.models import create_model_config, init_model
+from hydragnn_tpu.preprocess import apply_variables_of_interest
+
+from test_config import CI_CONFIG
+
+MULTIHEAD_VOI = {
+    "input_node_features": [0],
+    "output_names": ["sum", "x", "x2"],
+    "output_index": [0, 1, 2],
+    "type": ["graph", "node", "node"],
+    "denormalize_output": False,
+}
+
+
+def make_batch(config, n_samples=6, batch_size=3):
+    samples = deterministic_graph_data(number_configurations=n_samples, seed=3)
+    samples = apply_variables_of_interest(samples, config)
+    pad = compute_pad_spec(samples, batch_size)
+    return samples, collate(samples[:batch_size], pad)
+
+
+def build(config_mut=None, voi=None):
+    cfg = copy.deepcopy(CI_CONFIG)
+    if voi:
+        cfg["NeuralNetwork"]["Variables_of_interest"] = copy.deepcopy(voi)
+        nheads = len(voi["type"])
+        cfg["NeuralNetwork"]["Architecture"]["task_weights"] = [1.0] * nheads
+        cfg["NeuralNetwork"]["Architecture"]["output_heads"]["node"] = {
+            "num_headlayers": 2,
+            "dim_headlayers": [4, 4],
+            "type": "mlp",
+        }
+    if config_mut:
+        cfg["NeuralNetwork"]["Architecture"].update(config_mut)
+    samples = deterministic_graph_data(number_configurations=8, seed=3)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 4)
+    batch = jax.tree.map(jnp.asarray, collate(samples[:4], pad))
+    return model, batch, cfg
+
+
+def test_gin_single_graph_head_forward():
+    model, batch, _ = build()
+    variables = init_model(model, batch)
+    out = model.apply(variables, batch, train=False)
+    assert len(out) == 1
+    assert out[0].shape == (batch.num_graphs, 1)
+    assert np.all(np.isfinite(np.asarray(out[0])))
+
+
+def test_gin_multihead_forward_and_loss():
+    model, batch, _ = build(voi=MULTIHEAD_VOI)
+    variables = init_model(model, batch)
+    out = model.apply(variables, batch, train=False)
+    assert len(out) == 3
+    assert out[0].shape == (batch.num_graphs, 1)
+    assert out[1].shape == (batch.num_nodes, 1)
+    tot, tasks = model.loss(out, batch)
+    assert np.isfinite(float(tot)) and len(tasks) == 3
+    sses, counts = model.head_sse(out, batch)
+    assert len(sses) == 3 and len(counts) == 3
+    # counts reflect real (unpadded) rows only
+    assert float(counts[0]) == float(batch.graph_mask.sum())
+    assert float(counts[1]) == float(batch.node_mask.sum())
+
+
+def test_loss_ignores_padding():
+    """Doubling the padding must not change the loss."""
+    model, batch, cfg = build(voi=MULTIHEAD_VOI)
+    variables = init_model(model, batch)
+    out = model.apply(variables, batch, train=False)
+    tot1, _ = model.loss(out, batch)
+
+    from hydragnn_tpu.graphs.batching import PadSpec, collate
+    samples = deterministic_graph_data(number_configurations=8, seed=3)
+    samples = apply_variables_of_interest(samples, cfg)
+    big = PadSpec(n_node=128, n_edge=1024, n_graph=9)
+    batch2 = jax.tree.map(jnp.asarray, collate(samples[:4], big))
+    out2 = model.apply(variables, batch2, train=False)
+    tot2, _ = model.loss(out2, batch2)
+    np.testing.assert_allclose(float(tot1), float(tot2), rtol=2e-4)
+
+
+def test_batchnorm_stats_update_masked():
+    model, batch, _ = build()
+    variables = init_model(model, batch)
+    out, updates = model.apply(variables, batch, train=True, mutable=["batch_stats"])
+    stats = updates["batch_stats"]
+    leaf = jax.tree.leaves(stats)[0]
+    assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_gaussian_nll_var_output():
+    model, batch, _ = build(config_mut=None)
+    # switch to GaussianNLLLoss
+    import copy as _copy
+    from test_config import CI_CONFIG as BASE
+    cfg = _copy.deepcopy(BASE)
+    cfg["NeuralNetwork"]["Training"]["loss_function_type"] = "GaussianNLLLoss"
+    samples = deterministic_graph_data(number_configurations=8, seed=3)
+    samples = apply_variables_of_interest(samples, cfg)
+    from hydragnn_tpu.config import update_config as _uc
+    cfg = _uc(cfg, samples)
+    model = create_model_config(cfg)
+    from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+    pad = compute_pad_spec(samples, 4)
+    b = jax.tree.map(jnp.asarray, collate(samples[:4], pad))
+    variables = init_model(model, b)
+    out = model.apply(variables, b, train=False)
+    assert isinstance(out, tuple) and len(out) == 2  # (mu, var)
+    tot, tasks = model.loss(out, b)
+    assert np.isfinite(float(tot))
+
+
+def test_unknown_mpnn_type_raises():
+    from hydragnn_tpu.models import create_model
+    from hydragnn_tpu.config import ModelSpec
+    spec = ModelSpec(
+        mpnn_type="NOPE", input_dim=1, hidden_dim=4, num_conv_layers=1,
+        output_dim=(1,), output_type=("graph",), graph_heads=(), node_heads=(),
+        task_weights=(1.0,),
+    )
+    with pytest.raises(ValueError):
+        create_model(spec)
+
+
+def test_multibranch_multidim_head():
+    """Regression: 2-branch heads with output_dim > 1 must trace (the var
+    slice used to produce zero-width arrays that broke broadcasting)."""
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Variables_of_interest"] = {
+        "input_node_features": [0],
+        "output_index": [0],
+        "type": ["node"],
+        "output_dim": [3],
+    }
+    cfg["NeuralNetwork"]["Architecture"]["task_weights"] = [1.0]
+    cfg["NeuralNetwork"]["Architecture"]["output_heads"] = {
+        "node": [
+            {"type": "branch-0", "architecture": {"num_headlayers": 1, "dim_headlayers": [4], "type": "mlp"}},
+            {"type": "branch-1", "architecture": {"num_headlayers": 1, "dim_headlayers": [4], "type": "mlp"}},
+        ]
+    }
+    samples = deterministic_graph_data(number_configurations=6, seed=5)
+    for i, s in enumerate(samples):
+        s.node_y = np.random.default_rng(i).normal(size=(s.num_nodes, 3)).astype(np.float32)
+        s.dataset_id = i % 2
+        s.extras = {}
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 4)
+    batch = jax.tree.map(jnp.asarray, collate(samples[:4], pad))
+    variables = init_model(model, batch)
+    out = model.apply(variables, batch, train=False)
+    assert out[0].shape == (batch.num_nodes, 3)
+    # branch routing: graphs with dataset_id 0 vs 1 get different branch params
+    tot, _ = model.loss(out, batch)
+    assert np.isfinite(float(tot))
+
+
+def test_graph_head_without_shared_layers():
+    """Regression: num_sharedlayers=0 must skip the shared stack, not build
+    a zero-width Dense."""
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["output_heads"]["graph"] = {
+        "num_sharedlayers": 0,
+        "dim_sharedlayers": 0,
+        "num_headlayers": 1,
+        "dim_headlayers": [8],
+    }
+    samples = deterministic_graph_data(number_configurations=6, seed=5)
+    from hydragnn_tpu.preprocess import apply_variables_of_interest as avoi
+    samples = avoi(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 4)
+    batch = jax.tree.map(jnp.asarray, collate(samples[:4], pad))
+    variables = init_model(model, batch)
+    out = model.apply(variables, batch, train=False)
+    assert out[0].shape == (batch.num_graphs, 1)
+
+
+def test_run_training_defaults_missing_batch_size():
+    """Regression: Training without batch_size must fall back to default 32."""
+    import hydragnn_tpu
+    cfg = copy.deepcopy(CI_CONFIG)
+    del cfg["NeuralNetwork"]["Training"]["batch_size"]
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 1
+    samples = deterministic_graph_data(number_configurations=40, seed=5)
+    state, model, aug = hydragnn_tpu.run_training(cfg, samples=samples)
+    assert aug["NeuralNetwork"]["Training"]["batch_size"] == 32

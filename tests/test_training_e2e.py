@@ -1,0 +1,78 @@
+"""End-to-end convergence tests on the deterministic BCC dataset.
+
+The reference's workhorse test (``tests/test_graphs.py:25-310``) trains each
+architecture for 100 epochs on 500 synthetic samples and asserts per-head RMSE
+and sample MAE against per-model thresholds (GIN: 0.25 / 0.20 —
+``test_graphs.py:144-170``). These tests reproduce that gate through the full
+``run_training`` -> ``run_prediction`` API.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import hydragnn_tpu
+from hydragnn_tpu.datasets import deterministic_graph_data
+
+from test_config import CI_CONFIG
+
+# thresholds per architecture: (head RMSE, sample MAE) — reference values
+THRESHOLDS = {
+    "GIN": (0.25, 0.20),
+    "SAGE": (0.20, 0.20),
+    "GAT": (0.60, 0.70),
+    "MFC": (0.20, 0.30),
+    "CGCNN": (0.50, 0.40),
+    "PNA": (0.20, 0.20),
+    "PNAPlus": (0.20, 0.20),
+    "SchNet": (0.20, 0.20),
+    "DimeNet": (0.50, 0.50),
+    "EGNN": (0.20, 0.20),
+    "PAINN": (0.60, 0.60),
+    "PNAEq": (0.60, 0.60),
+    "MACE": (0.60, 0.70),
+}
+
+
+def run_arch_e2e(mpnn_type, overrides=None, multihead=False, n_configs=400, epochs=60):
+    cfg = copy.deepcopy(CI_CONFIG)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch["mpnn_type"] = mpnn_type
+    if overrides:
+        arch.update(overrides)
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = epochs
+    cfg["NeuralNetwork"]["Training"]["Optimizer"]["learning_rate"] = 0.02
+    if multihead:
+        cfg["NeuralNetwork"]["Variables_of_interest"] = {
+            "input_node_features": [0],
+            "output_names": ["sum", "x", "x2"],
+            "output_index": [0, 1, 2],
+            "type": ["graph", "node", "node"],
+            "denormalize_output": False,
+        }
+        arch["task_weights"] = [1.0, 1.0, 1.0]
+        arch["output_heads"]["node"] = {
+            "num_headlayers": 2,
+            "dim_headlayers": [4, 4],
+            "type": "mlp",
+        }
+
+    samples = deterministic_graph_data(number_configurations=n_configs, seed=7)
+    state, model, aug_cfg = hydragnn_tpu.run_training(cfg, samples=samples)
+    error, tasks_loss, trues, preds = hydragnn_tpu.run_prediction(cfg, state, model, samples=samples)
+
+    rmse_thr, mae_thr = THRESHOLDS[mpnn_type]
+    for ihead, (t, p) in enumerate(zip(trues, preds)):
+        rmse = float(np.sqrt(np.mean((t - p) ** 2)))
+        mae = float(np.mean(np.abs(t - p)))
+        assert rmse < rmse_thr, f"{mpnn_type} head {ihead} RMSE {rmse:.3f} >= {rmse_thr}"
+        assert mae < mae_thr, f"{mpnn_type} head {ihead} sample MAE {mae:.3f} >= {mae_thr}"
+
+
+def test_gin_singlehead_convergence():
+    run_arch_e2e("GIN")
+
+
+def test_gin_multihead_convergence():
+    run_arch_e2e("GIN", multihead=True)
