@@ -1,0 +1,128 @@
+"""Benchmark: steady-state training throughput (graphs/sec/chip) on the real
+TPU.
+
+Workload: QM9-scale molecular graphs (~18 heavy+H atoms, radius graph) with
+the flagship multi-head model, mirroring the BASELINE.md measurement protocol
+(pinned batches/epoch, throughput read from the train span). Prints ONE JSON
+line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline`` compares against the previous round's recorded value in
+BENCH_r*.json when present (relative speedup), else 1.0.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def make_qm9_like_samples(n: int, seed: int = 0):
+    """Synthetic molecule-sized graphs: 9-29 atoms, positions in a ~6A box,
+    radius graph at 3.0A — QM9-like node/edge statistics."""
+    from hydragnn_tpu.graphs.graph import GraphSample
+    from hydragnn_tpu.graphs.radius import radius_graph
+
+    rng = np.random.default_rng(seed)
+    samples = []
+    for _ in range(n):
+        na = int(rng.integers(9, 30))
+        pos = rng.uniform(0, 6.0, size=(na, 3))
+        z = rng.integers(1, 10, size=(na, 1)).astype(np.float32)
+        s, r, sh = radius_graph(pos, radius=3.0, max_neighbours=20)
+        samples.append(
+            GraphSample(
+                x=z,
+                pos=pos,
+                senders=s,
+                receivers=r,
+                edge_shifts=sh,
+                graph_y=rng.normal(size=(1,)),
+                node_y=rng.normal(size=(na, 1)),
+            )
+        )
+    return samples
+
+
+def main():
+    import jax
+
+    from hydragnn_tpu.config import ModelSpec, update_config
+    from hydragnn_tpu.graphs.batching import GraphLoader, compute_pad_spec
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train import create_train_state, make_train_step, select_optimizer
+    import copy
+
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    batch_size = int(os.getenv("BENCH_BATCH_SIZE", "256"))
+    n_samples = max(batch_size * 4, 512)
+    warmup_steps = 5
+    bench_steps = int(os.getenv("BENCH_STEPS", "30"))
+
+    samples = make_qm9_like_samples(n_samples)
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 64
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    cfg["NeuralNetwork"]["Training"]["precision"] = "bf16"
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+
+    loader = GraphLoader(samples, batch_size, shuffle=True)
+    batches = [jax.tree.map(jax.numpy.asarray, b) for b in loader]
+    state = create_train_state(model, optimizer, batches[0])
+    import jax.numpy as jnp
+
+    train_step = make_train_step(model, optimizer, compute_dtype=jnp.bfloat16)
+
+    # warmup (compile)
+    for i in range(warmup_steps):
+        state, metrics = train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(bench_steps):
+        state, metrics = train_step(state, batches[i % len(batches)])
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    graphs_per_sec = bench_steps * batch_size / dt
+    n_chips = jax.device_count()
+    value = graphs_per_sec / n_chips
+
+    def _round_no(path: str) -> int:
+        import re
+
+        m = re.search(r"BENCH_r(\d+)\.json", path)
+        return int(m.group(1)) if m else -1
+
+    prev = None
+    for f in sorted(glob.glob("BENCH_r*.json"), key=_round_no):
+        try:
+            with open(f) as fh:
+                rec = json.load(fh)
+            if isinstance(rec, dict) and "value" in rec:
+                prev = float(rec["value"])
+        except Exception:
+            pass
+    vs_baseline = (value / prev) if prev else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_throughput_qm9like_gin_bf16",
+                "value": round(value, 2),
+                "unit": "graphs/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -88,15 +88,17 @@ def update_multibranch_heads(output_heads: dict) -> dict:
 def _degree_histogram(samples) -> list[int]:
     """In-degree histogram over the training set — PNA's ``deg`` input
     (reference ``gather_deg``, ``graph_samples_checks_and_updates.py:526-601``)."""
-    max_deg = 0
-    counts: dict[int, int] = {}
+    per_sample = []
     for s in samples:
         deg = np.bincount(np.asarray(s.receivers), minlength=s.num_nodes)[: s.num_nodes]
-        for d in deg:
-            counts[int(d)] = counts.get(int(d), 0) + 1
-            max_deg = max(max_deg, int(d))
-    hist = [counts.get(d, 0) for d in range(max_deg + 1)]
-    return hist
+        per_sample.append(np.bincount(deg))
+    if not per_sample:
+        return [0]
+    width = max(h.shape[0] for h in per_sample)
+    hist = np.zeros(width, np.int64)
+    for h in per_sample:
+        hist[: h.shape[0]] += h
+    return hist.tolist()
 
 
 def _avg_num_neighbors(samples) -> float:

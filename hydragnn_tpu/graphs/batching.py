@@ -172,9 +172,9 @@ class GraphLoader:
         rank: int = 0,
         world: int = 1,
     ):
-        if not samples:
-            raise ValueError("empty dataset")
         self.samples = list(samples)
+        if not self.samples and pad is None:
+            raise ValueError("empty dataset needs an explicit pad spec")
         self.batch_size = int(batch_size)
         self.pad = pad or compute_pad_spec(self.samples, self.batch_size)
         self.shuffle = shuffle
@@ -189,6 +189,8 @@ class GraphLoader:
 
     def _epoch_indices(self) -> np.ndarray:
         n = len(self.samples)
+        if n == 0:
+            return np.zeros((0,), np.int64)
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             idx = rng.permutation(n)

@@ -39,9 +39,16 @@ def create_model_config(config: dict) -> HydraModel:
 def create_model(spec: ModelSpec) -> HydraModel:
     if spec.mpnn_type not in CONV_REGISTRY:
         known = sorted(CONV_REGISTRY)
+        hint = ""
+        failed = _IMPORT_ERRORS.get(spec.mpnn_type.lower())
+        if failed is not None:
+            hint = (
+                f" The '{spec.mpnn_type.lower()}' module exists but failed to "
+                f"import: {failed!r}."
+            )
         raise ValueError(
             f"Unknown or not-yet-registered mpnn_type '{spec.mpnn_type}'. "
-            f"Registered: {known}"
+            f"Registered: {known}.{hint}"
         )
     return HydraModel(spec=spec)
 

@@ -1,0 +1,31 @@
+from .mesh import (
+    DATA_AXIS,
+    BRANCH_AXIS,
+    make_mesh,
+    batch_sharding,
+    replicated,
+    fsdp_param_specs,
+)
+from .step import (
+    make_parallel_train_step,
+    make_parallel_eval_step,
+    shard_state,
+    stack_device_batches,
+    put_batch,
+    batch_shardings,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "BRANCH_AXIS",
+    "make_mesh",
+    "batch_sharding",
+    "replicated",
+    "fsdp_param_specs",
+    "make_parallel_train_step",
+    "make_parallel_eval_step",
+    "shard_state",
+    "stack_device_batches",
+    "put_batch",
+    "batch_shardings",
+]

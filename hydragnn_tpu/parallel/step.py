@@ -1,0 +1,167 @@
+"""SPMD train/eval steps over a device mesh.
+
+The TPU replacement for the reference's DDP/FSDP/DeepSpeed wrappers
+(``hydragnn/utils/distributed/distributed.py:396-536``): one jitted global
+program where
+
+* the batch carries a leading device axis ``[D, ...]`` sharded over the mesh's
+  ``data`` axis — each device computes its own padded graph batch end-to-end
+  with **zero** forward communication (graphs never straddle devices, as in
+  the reference's per-rank DataLoader);
+* parameters are replicated (DDP semantics) or sharded over ``data`` (FSDP /
+  ZeRO-3 semantics, ``fsdp_param_specs``) — the XLA SPMD partitioner inserts
+  the gradient all-reduce / per-layer all-gathers that DDP and FSDP implement
+  by hand with NCCL;
+* the loss is the graph-count-weighted mean over device sub-batches, matching
+  the reference's ``x NUM graphs -> allreduce -> / total`` bookkeeping
+  (``train_validate_test.py:795-799``).
+
+The same step function runs unchanged on 1 device or a v5p pod — only the
+mesh and shardings differ.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..graphs.graph import GraphBatch
+from ..models.base import HydraModel
+from ..train.step import TrainState, _cast_floats
+from .mesh import DATA_AXIS, fsdp_param_specs
+
+
+def stack_device_batches(batches: list[GraphBatch]) -> GraphBatch:
+    """Stack per-device batches into one [D, ...] GraphBatch."""
+    return GraphBatch(*[np.stack(f) for f in zip(*batches)])
+
+
+def shard_state(state: TrainState, mesh: Mesh, param_mode: str = "replicated") -> TrainState:
+    """Place a TrainState on the mesh (replicated or FSDP-sharded params;
+    optimizer state follows the param sharding — ZeRO-1 for free)."""
+    if param_mode == "fsdp":
+        pspecs = fsdp_param_specs(state.params, mesh)
+    else:
+        pspecs = jax.tree.map(lambda _: P(), state.params)
+
+    def put(tree, specs):
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+        )
+
+    params = put(state.params, pspecs)
+    stats = jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, P())), state.batch_stats)
+
+    def opt_spec_for(x):
+        # optimizer moments mirror the param tree where shapes match
+        return P()
+
+    # shard optimizer state leaves that match a param's shape with that
+    # param's spec; everything else replicated
+    flat_params, treedef = jax.tree.flatten(state.params)
+    shape_to_spec = {}
+    for p, s in zip(flat_params, jax.tree.leaves(pspecs)):
+        shape_to_spec.setdefault((p.shape, p.dtype), s)
+
+    def place_opt(x):
+        if hasattr(x, "shape"):
+            s = shape_to_spec.get((x.shape, x.dtype), P())
+            return jax.device_put(x, NamedSharding(mesh, s))
+        return x
+
+    opt_state = jax.tree.map(place_opt, state.opt_state)
+    step = jax.device_put(state.step, NamedSharding(mesh, P()))
+    return TrainState(params=params, batch_stats=stats, opt_state=opt_state, step=step)
+
+
+def batch_shardings(mesh: Mesh) -> GraphBatch:
+    s = NamedSharding(mesh, P(DATA_AXIS))
+    return GraphBatch(*([s] * len(GraphBatch._fields)))
+
+
+def put_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
+    """Device-put a stacked [D, ...] batch with leading axis over data."""
+    sh = batch_shardings(mesh)
+    return jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s), batch, sh)
+
+
+def make_parallel_train_step(
+    model: HydraModel, optimizer, mesh: Mesh, compute_dtype=jnp.float32
+):
+    """Jitted SPMD train step: (state, stacked_batch[D, ...]) -> (state, metrics)."""
+
+    def loss_fn(params, batch_stats, batches: GraphBatch):
+        c_params = _cast_floats(params, compute_dtype)
+        c_batches = _cast_floats(batches, compute_dtype)
+
+        def per_device(b):
+            outputs, updates = model.apply(
+                {"params": c_params, "batch_stats": batch_stats},
+                b,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            pred = _cast_floats(outputs, jnp.float32)
+            tot, tasks = model.loss(pred, b)
+            ng = b.graph_mask.sum()
+            return tot * ng, jnp.stack(tasks) * ng, ng, updates["batch_stats"]
+
+        tots, tasks, ngs, new_stats = jax.vmap(per_device)(c_batches)
+        denom = jnp.maximum(ngs.sum(), 1.0)
+        loss = tots.sum() / denom
+        # running stats: average replicas (reference default — SyncBatchNorm off)
+        new_stats = jax.tree.map(lambda x: x.mean(axis=0), new_stats)
+        return loss, (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
+
+    @jax.jit
+    def train_step(state: TrainState, batches: GraphBatch):
+        (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.batch_stats, batches)
+        grads = _cast_floats(grads, jnp.float32)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        return new_state, {"loss": loss, "tasks_loss": tasks, "num_graphs": ng}
+
+    return train_step
+
+
+def make_parallel_eval_step(model: HydraModel, mesh: Mesh, compute_dtype=jnp.float32):
+    @jax.jit
+    def eval_step(state: TrainState, batches: GraphBatch):
+        c_params = _cast_floats(state.params, compute_dtype)
+        c_batches = _cast_floats(batches, compute_dtype)
+
+        def per_device(b):
+            outputs = model.apply(
+                {"params": c_params, "batch_stats": state.batch_stats}, b, train=False
+            )
+            pred = _cast_floats(outputs, jnp.float32)
+            tot, tasks = model.loss(pred, b)
+            sses, counts = model.head_sse(pred, b)
+            ng = b.graph_mask.sum()
+            return tot * ng, jnp.stack(tasks) * ng, jnp.stack(sses), jnp.stack(counts), ng
+
+        tots, tasks, sses, counts, ngs = jax.vmap(per_device)(c_batches)
+        denom = jnp.maximum(ngs.sum(), 1.0)
+        return {
+            "loss": tots.sum() / denom,
+            "tasks_loss": tasks.sum(axis=0) / denom,
+            "head_sse": sses.sum(axis=0),
+            "head_count": counts.sum(axis=0),
+            "num_graphs": ngs.sum(),
+        }
+
+    return eval_step

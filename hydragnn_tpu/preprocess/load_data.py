@@ -112,11 +112,39 @@ def normalize_features(samples) -> tuple[np.ndarray, np.ndarray]:
     return node_minmax, graph_minmax
 
 
+def _composition_key(sample: GraphSample) -> tuple:
+    """Composition signature: sorted (type, count) pairs of the first input
+    feature column (the atom type in every reference dataset)."""
+    if sample.x.size == 0:
+        return ()
+    types, counts = np.unique(sample.x[:, 0].round(6), return_counts=True)
+    return tuple(zip(types.tolist(), counts.tolist()))
+
+
 def split_dataset(samples, perc_train: float, stratify_splitting: bool = False, seed: int = 0):
-    """Random train/val/test split: val and test each get (1-perc_train)/2
-    (reference ``load_data.py:337-357``)."""
-    n = len(samples)
+    """Train/val/test split: val and test each get (1-perc_train)/2
+    (reference ``load_data.py:337-357``). With ``stratify_splitting``, samples
+    are grouped by atomic composition and each group is split proportionally
+    (reference ``compositional_data_splitting.py``), so every split sees every
+    composition."""
     rng = np.random.default_rng(seed)
+    if stratify_splitting:
+        groups: dict[tuple, list[int]] = {}
+        for i, s in enumerate(samples):
+            groups.setdefault(_composition_key(s), []).append(i)
+        train_idx, val_idx, test_idx = [], [], []
+        for key in sorted(groups):
+            idx = np.asarray(groups[key])
+            idx = idx[rng.permutation(len(idx))]
+            n = len(idx)
+            n_train = int(n * perc_train)
+            n_val = int(n * (1.0 - perc_train) / 2.0)
+            train_idx.extend(idx[:n_train].tolist())
+            val_idx.extend(idx[n_train : n_train + n_val].tolist())
+            test_idx.extend(idx[n_train + n_val :].tolist())
+        perm_of = lambda lst: [samples[i] for i in lst]
+        return perm_of(train_idx), perm_of(val_idx), perm_of(test_idx)
+    n = len(samples)
     perm = rng.permutation(n)
     n_train = int(n * perc_train)
     n_val = int(n * (1.0 - perc_train) / 2.0)
@@ -146,6 +174,8 @@ def create_dataloaders(
     train_loader = GraphLoader(
         trainset, batch_size, pad=pad, shuffle=True, seed=seed, rank=rank, world=world
     )
+    # val/test may legitimately be empty (tiny datasets, perc_train=1.0);
+    # the train loop skips evaluation then
     val_loader = GraphLoader(valset, batch_size, pad=pad, drop_last=False, rank=rank, world=world)
     test_loader = GraphLoader(testset, batch_size, pad=pad, drop_last=False, rank=rank, world=world)
     return train_loader, val_loader, test_loader

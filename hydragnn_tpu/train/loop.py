@@ -125,6 +125,10 @@ def train_validate_test(
         else None
     )
     skip_valtest = os.getenv("HYDRAGNN_VALTEST", "1") == "0"
+    # a dataset too small (or perc_train=1.0) can leave val/test empty —
+    # train-only in that case instead of crashing
+    if len(val_loader.samples) == 0 or len(test_loader.samples) == 0:
+        skip_valtest = True
 
     for epoch in range(num_epoch):
         train_loader.set_epoch(epoch)

@@ -99,3 +99,31 @@ def test_collate_requires_reserved_padding_node():
     with pytest.raises(ValueError):
         collate([s], PadSpec(n_node=8, n_edge=8, n_graph=2))
     collate([s], PadSpec(n_node=9, n_edge=8, n_graph=2))  # one spare -> fine
+
+
+def test_stratified_split_covers_compositions():
+    from hydragnn_tpu.preprocess import split_dataset
+    # two distinct compositions, 10 samples each
+    samples = []
+    for i in range(20):
+        s = make_sample(4, 6, seed=i)
+        s.x[:, 0] = float(i % 2)  # composition marker
+        samples.append(s)
+    train, val, test = split_dataset(samples, perc_train=0.6, stratify_splitting=True)
+    for split in (train, val, test):
+        comps = {float(s.x[0, 0]) for s in split}
+        assert comps == {0.0, 1.0}, "every split must see every composition"
+    assert len(train) + len(val) + len(test) == 20
+
+
+def test_empty_split_trains_without_valtest():
+    import hydragnn_tpu
+    from test_config import CI_CONFIG
+    import copy
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 1
+    cfg["NeuralNetwork"]["Training"]["perc_train"] = 1.0
+    samples = deterministic_graph_data(number_configurations=20, seed=4)
+    state, model, aug = hydragnn_tpu.run_training(cfg, samples=samples)
+    assert state.step > 0
