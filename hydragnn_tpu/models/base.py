@@ -115,6 +115,7 @@ class HydraModel(nn.Module):
     def setup(self):
         spec = self.spec
         conv_cls = CONV_REGISTRY[spec.mpnn_type]
+        use_feature_norm = getattr(conv_cls, "feature_norm", True)
         if spec.conv_checkpointing:
             # trade recompute for HBM: rematerialize each conv block on backward
             # (reference uses torch checkpointing at Base.py:714-721)
@@ -122,8 +123,10 @@ class HydraModel(nn.Module):
         self.graph_convs = [
             conv_cls(spec=spec, layer=i) for i in range(spec.num_conv_layers)
         ]
+        # some stacks (SchNet) use identity feature layers in the reference
         self.feature_layers = [
-            MaskedBatchNorm(name=f"feature_norm_{i}") for i in range(spec.num_conv_layers)
+            (MaskedBatchNorm(name=f"feature_norm_{i}") if use_feature_norm else None)
+            for i in range(spec.num_conv_layers)
         ]
 
         # graph-head shared layers + per-head MLPs, per branch
@@ -217,9 +220,12 @@ class HydraModel(nn.Module):
     def encode(self, batch: GraphBatch, train: bool = False):
         """Run the conv stack; returns (node_features, equiv_features)."""
         inv, equiv = self.embed(batch)
+        act = get_activation(self.spec.activation)
         for conv, norm in zip(self.graph_convs, self.feature_layers):
-            inv, equiv = conv(inv, equiv, batch)
-            inv = get_activation(self.spec.activation)(norm(inv, batch.node_mask, train))
+            inv, equiv = conv(inv, equiv, batch, train=train)
+            if norm is not None:
+                inv = norm(inv, batch.node_mask, train)
+            inv = act(inv)
         return inv, equiv
 
     def embed(self, batch: GraphBatch):
@@ -274,7 +280,7 @@ class HydraModel(nn.Module):
                     if node_type == "conv":
                         h, e = inv, equiv
                         for conv in per_branch[b.branch]:
-                            h, e = conv(h, e, batch)
+                            h, e = conv(h, e, batch, train=train)
                         o = h
                     elif node_type == "mlp_per_node":
                         o = per_branch[b.branch](inv, local_idx)

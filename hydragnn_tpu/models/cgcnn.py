@@ -1,0 +1,43 @@
+"""CGCNN conv stack (reference ``hydragnn/models/CGCNNStack.py:19-113``, PyG
+``CGConv``): crystal graph conv with gated residual update
+x_i' = x_i + sum_j sigmoid(W_f z_ij) * softplus(W_s z_ij),
+z_ij = [x_i, x_j, e_ij].
+
+Dimensional quirk kept from the reference: hidden_dim is forced equal to
+input_dim when GPS is off (``config_utils.py:76-83``) because the update is
+residual (output dim == input dim)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from ..graphs.graph import GraphBatch
+from ..graphs import segment
+from .base import register_conv
+
+
+@register_conv("CGCNN")
+class CGCNNConv(nn.Module):
+    spec: ModelSpec
+    layer: int
+    out_dim: int | None = None
+
+    @nn.compact
+    def __call__(
+        self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
+    ):
+        dim = inv.shape[-1]
+        z = jnp.concatenate([inv[batch.receivers], inv[batch.senders]], axis=-1)
+        if self.spec.edge_dim and batch.edge_attr.shape[1]:
+            z = jnp.concatenate([z, batch.edge_attr], axis=-1)
+        gate = nn.sigmoid(nn.Dense(dim, name="lin_f")(z))
+        core = nn.softplus(nn.Dense(dim, name="lin_s")(z))
+        msg = gate * core * batch.edge_mask[:, None]
+        agg = segment.segment_sum(msg, batch.receivers, batch.num_nodes)
+        out = inv + agg  # residual (aggr='add' in reference CGConv)
+        if self.out_dim is not None and self.out_dim != dim:
+            out = nn.Dense(self.out_dim, name="proj")(out)
+        return out, equiv

@@ -1,0 +1,84 @@
+"""GATv2 conv stack (reference ``hydragnn/models/GATStack.py``, PyG
+``GATv2Conv`` with heads=6, add_self_loops=True).
+
+Reference head layout (``GATStack._init_conv``): layers 0..L-2 concatenate
+heads (features = hidden*heads), the last layer averages them (features =
+hidden). Attention logits use the GATv2 form a^T LeakyReLU(W_l x_i + W_r x_j
+[+ W_e e_ij]) with softmax over each receiver's in-edges *including* a self
+loop. Self loops are materialized as N extra static edge slots (senders =
+receivers = arange(N)) so shapes stay jit-constant.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from ..graphs.graph import GraphBatch
+from ..graphs import segment
+from .base import register_conv
+
+HEADS = 6  # reference create.py:263 hardcodes 6 attention heads
+NEGATIVE_SLOPE = 0.05  # reference create.py:264
+
+
+@register_conv("GAT")
+class GATConv(nn.Module):
+    spec: ModelSpec
+    layer: int
+    out_dim: int | None = None
+    concat_override: bool | None = None
+
+    @nn.compact
+    def __call__(
+        self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
+    ):
+        spec = self.spec
+        hidden = self.out_dim or spec.hidden_dim
+        # last conv layer averages heads instead of concatenating
+        concat = (
+            self.concat_override
+            if self.concat_override is not None
+            else self.layer < spec.num_conv_layers - 1
+        )
+        N = batch.num_nodes
+        H, F = HEADS, hidden
+
+        x_l = nn.Dense(H * F, name="lin_l")(inv).reshape(N, H, F)
+        x_r = nn.Dense(H * F, name="lin_r")(inv).reshape(N, H, F)
+        att = self.param("att", nn.initializers.lecun_normal(), (H, F))
+
+        # real edges + one self-loop slot per node (static shapes)
+        senders = jnp.concatenate([batch.senders, jnp.arange(N, dtype=batch.senders.dtype)])
+        receivers = jnp.concatenate(
+            [batch.receivers, jnp.arange(N, dtype=batch.receivers.dtype)]
+        )
+        e_mask = jnp.concatenate([batch.edge_mask, jnp.ones((N,), batch.edge_mask.dtype)])
+
+        z = x_l[senders] + x_r[receivers]  # [E+N, H, F]
+        if spec.edge_dim:
+            # self-loop edge features use the mean of each node's incident
+            # real edge features (PyG add_self_loops fill_value='mean')
+            masked_ea = batch.edge_attr * batch.edge_mask[:, None]
+            ea_sum = segment.segment_sum(masked_ea, batch.receivers, N)
+            deg = segment.segment_sum(batch.edge_mask, batch.receivers, N)
+            self_ea = ea_sum / jnp.maximum(deg, 1.0)[:, None]
+            ea = jnp.concatenate([batch.edge_attr, self_ea], axis=0)
+            z = z + nn.Dense(H * F, name="lin_edge")(ea).reshape(-1, H, F)
+        z = nn.leaky_relu(z, negative_slope=NEGATIVE_SLOPE)
+        logits = jnp.einsum("ehf,hf->eh", z, att)
+        # mask padded edges out of the softmax
+        logits = jnp.where(e_mask[:, None] > 0, logits, -1e9)
+        alpha = segment.segment_softmax(logits, receivers, N)  # [E+N, H]
+        alpha = alpha * e_mask[:, None]
+        # attention-coefficient dropout (reference GATv2Conv dropout=0.25)
+        alpha = nn.Dropout(rate=self.spec.dropout, name="attn_drop")(
+            alpha, deterministic=not train
+        )
+
+        msg = x_l[senders] * alpha[:, :, None]  # [E+N, H, F]
+        out = segment.segment_sum(msg, receivers, N)  # [N, H, F]
+        out = out.reshape(N, H * F) if concat else out.mean(axis=1)
+        return out, equiv

@@ -26,7 +26,9 @@ class GINConv(nn.Module):
     out_dim: int | None = None
 
     @nn.compact
-    def __call__(self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch):
+    def __call__(
+        self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
+    ):
         hidden = self.out_dim or self.spec.hidden_dim
         eps = self.param("eps", nn.initializers.zeros, ())
         messages = inv[batch.senders] * batch.edge_mask[:, None]

@@ -1,0 +1,134 @@
+"""PNA conv stack (reference ``hydragnn/models/PNAStack.py:19-70``, PyG
+``PNAConv``): Principal Neighbourhood Aggregation — multi-aggregator
+(mean/min/max/std) message passing with degree-dependent scalers
+(identity/amplification/attenuation/linear, reference ``PNAStack.py:31-36``)
+calibrated on the training-set degree histogram (``pna_deg`` derived in
+config augmentation).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.schema import ModelSpec
+from ..graphs.graph import GraphBatch
+from ..graphs import segment
+from .base import register_conv
+from .common import MLP
+
+AGGREGATORS = ("mean", "min", "max", "std")
+SCALERS = ("identity", "amplification", "attenuation", "linear")
+
+
+def avg_degree_linear(deg_hist) -> float:
+    """Plain mean degree — normalizer for the 'linear' scaler."""
+    hist = np.asarray(deg_hist, np.float64)
+    d = np.arange(len(hist))
+    total = hist.sum()
+    return float((d * hist).sum() / total) if total else 1.0
+
+
+def log_degree_mean(deg_hist) -> float:
+    """delta = E_hist[log(d+1)] — the scaler normalization constant (PyG
+    ``DegreeScalerAggregation``)."""
+    hist = np.asarray(deg_hist, np.float64)
+    d = np.arange(len(hist))
+    total = hist.sum()
+    if total == 0:
+        return 1.0
+    return float((np.log(d + 1) * hist).sum() / total)
+
+
+def degree_scaled_aggregate(
+    msg: jax.Array,
+    receivers: jax.Array,
+    edge_mask: jax.Array,
+    num_nodes: int,
+    delta: float,
+    aggregators=AGGREGATORS,
+    scalers=SCALERS,
+    avg_deg_lin: float | None = None,
+) -> jax.Array:
+    """[E, F] messages -> [N, len(aggr)*len(scalers)*F] aggregated features.
+
+    Masking: padded edges carry zeroed messages for sum/mean; for min/max/std
+    they are routed to the dummy node slot already (receivers point at the
+    padded node), so real-node statistics are unaffected.
+    """
+    msg_sum = msg * edge_mask[:, None]
+    outs = []
+    deg = segment.segment_sum(edge_mask, receivers, num_nodes)
+    safe_deg = jnp.maximum(deg, 1.0)
+    for a in aggregators:
+        if a == "mean":
+            outs.append(
+                segment.segment_sum(msg_sum, receivers, num_nodes) / safe_deg[:, None]
+            )
+        elif a == "min":
+            outs.append(segment.segment_min(msg, receivers, num_nodes))
+        elif a == "max":
+            outs.append(segment.segment_max(msg, receivers, num_nodes))
+        elif a == "std":
+            mean = segment.segment_sum(msg_sum, receivers, num_nodes) / safe_deg[:, None]
+            mean_sq = (
+                segment.segment_sum(msg_sum * msg, receivers, num_nodes)
+                / safe_deg[:, None]
+            )
+            outs.append(jnp.sqrt(jnp.maximum(mean_sq - mean**2, 0.0) + 1e-5))
+        elif a == "sum":
+            outs.append(segment.segment_sum(msg_sum, receivers, num_nodes))
+        else:
+            raise ValueError(f"unknown aggregator {a}")
+    agg = jnp.concatenate(outs, axis=-1)  # [N, A*F]
+
+    log_deg = jnp.log(deg + 1.0)
+    scaled = []
+    for s in scalers:
+        if s == "identity":
+            scaled.append(agg)
+        elif s == "amplification":
+            scaled.append(agg * (log_deg / delta)[:, None])
+        elif s == "attenuation":
+            scaled.append(agg * (delta / jnp.maximum(log_deg, 1e-6))[:, None])
+        elif s == "linear":
+            scaled.append(agg * (deg / max(avg_deg_lin or 1.0, 1e-6))[:, None])
+        else:
+            raise ValueError(f"unknown scaler {s}")
+    return jnp.concatenate(scaled, axis=-1)  # [N, A*S*F]
+
+
+@register_conv("PNA")
+class PNAConv(nn.Module):
+    spec: ModelSpec
+    layer: int
+    out_dim: int | None = None
+
+    @nn.compact
+    def __call__(
+        self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
+    ):
+        spec = self.spec
+        hidden = self.out_dim or spec.hidden_dim
+        F = inv.shape[-1]
+        delta = log_degree_mean(spec.pna_deg or [0, 1])
+
+        h = jnp.concatenate([inv[batch.receivers], inv[batch.senders]], axis=-1)
+        if spec.edge_dim and batch.edge_attr.shape[1]:
+            h = jnp.concatenate([h, batch.edge_attr], axis=-1)
+        msg = nn.Dense(F, name="pre_nn")(h)  # pre_layers=1 (reference)
+
+        agg = degree_scaled_aggregate(
+            msg,
+            batch.receivers,
+            batch.edge_mask,
+            batch.num_nodes,
+            delta,
+            avg_deg_lin=avg_degree_linear(spec.pna_deg or [0, 1]),
+        )
+        out = jnp.concatenate([inv, agg], axis=-1)
+        out = nn.Dense(hidden, name="post_nn")(out)  # post_layers=1
+        out = nn.Dense(hidden, name="lin")(out)
+        return out, equiv

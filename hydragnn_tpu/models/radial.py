@@ -1,0 +1,115 @@
+"""Radial basis functions + cutoffs — shared by SchNet/PNAPlus/DimeNet/PaiNN/
+PNAEq/MACE.
+
+Reference counterparts: PyG ``GaussianSmearing``/``BesselBasisLayer`` (used by
+``SCFStack``/``PNAPlusStack``/``DIMEStack``) and
+``hydragnn/utils/model/mace_utils/modules/radial.py`` (Bessel / Chebyshev
+bases, ``PolynomialCutoff``). All are pure elementwise functions of the edge
+length — XLA fuses them into the surrounding message computation.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class GaussianSmearing(nn.Module):
+    """Distances -> Gaussian RBF grid on [start, stop] (SchNet's expansion)."""
+
+    start: float = 0.0
+    stop: float = 5.0
+    num_gaussians: int = 50
+
+    @nn.compact
+    def __call__(self, dist: Array) -> Array:
+        offset = jnp.linspace(self.start, self.stop, self.num_gaussians)
+        coeff = -0.5 / (offset[1] - offset[0]) ** 2 if self.num_gaussians > 1 else -0.5
+        d = dist.reshape(-1, 1) - offset.reshape(1, -1)
+        return jnp.exp(coeff * d**2)
+
+
+def polynomial_envelope(x: Array, exponent: int) -> Array:
+    """DimeNet smooth envelope u(x) on x = d/cutoff in [0, 1]:
+    1/x + a x^p + b x^(p+1) + c x^(p+2) with u(1)=u'(1)=u''(1)=0
+    (multiplied by x here so callers get the d-space form sin-basis needs)."""
+    p = exponent + 1
+    a = -(p + 1) * (p + 2) / 2.0
+    b = p * (p + 2)
+    c = -p * (p + 1) / 2.0
+    env = 1.0 / jnp.where(x == 0, 1.0, x) + a * x ** (p - 1) + b * x**p + c * x ** (p + 1)
+    return jnp.where(x < 1.0, env, jnp.zeros_like(env))
+
+
+class BesselBasis(nn.Module):
+    """DimeNet Bessel radial basis with polynomial envelope (PyG
+    ``BesselBasisLayer``; also MACE's ``BesselBasis``). Frequencies are
+    trainable, initialized at n*pi."""
+
+    num_radial: int = 6
+    cutoff: float = 5.0
+    envelope_exponent: int = 5
+
+    @nn.compact
+    def __call__(self, dist: Array) -> Array:
+        freq = self.param(
+            "freq",
+            lambda key: jnp.arange(1, self.num_radial + 1, dtype=jnp.float32) * math.pi,
+        )
+        d = dist.reshape(-1) / self.cutoff
+        env = polynomial_envelope(d, self.envelope_exponent)
+        return env[:, None] * jnp.sin(freq[None, :] * d[:, None])
+
+
+def cosine_cutoff(dist: Array, cutoff: float) -> Array:
+    """SchNet/PaiNN cosine cutoff window C(d) in [0, 1]."""
+    c = 0.5 * (jnp.cos(dist * math.pi / cutoff) + 1.0)
+    return jnp.where(dist <= cutoff, c, jnp.zeros_like(c))
+
+
+def polynomial_cutoff(dist: Array, cutoff: float, p: int = 6) -> Array:
+    """MACE ``PolynomialCutoff`` (radial.py:118): smooth f(d) with p-th order
+    continuity, f(0)=1, f(cutoff)=0."""
+    x = dist / cutoff
+    out = (
+        1.0
+        - ((p + 1.0) * (p + 2.0) / 2.0) * x**p
+        + p * (p + 2.0) * x ** (p + 1)
+        - (p * (p + 1.0) / 2.0) * x ** (p + 2)
+    )
+    return jnp.where(x < 1.0, out, jnp.zeros_like(out))
+
+
+def sinc_expansion(dist: Array, num_basis: int, cutoff: float) -> Array:
+    """PaiNN's sin(n pi d / r_cut)/d expansion (reference ``PainnMessage``,
+    ``PAINNStack.py:331-349``)."""
+    n = jnp.arange(1, num_basis + 1, dtype=jnp.float32)
+    d = dist.reshape(-1, 1)
+    safe = jnp.where(d == 0, 1.0, d)
+    return jnp.where(d == 0, n * math.pi / cutoff, jnp.sin(n * math.pi * d / cutoff) / safe)
+
+
+class ChebyshevBasis(nn.Module):
+    """Chebyshev polynomial radial basis on rescaled distances (MACE option,
+    ``mace_utils/modules/radial.py``)."""
+
+    num_basis: int = 8
+    cutoff: float = 5.0
+
+    @nn.compact
+    def __call__(self, dist: Array) -> Array:
+        x = jnp.clip(2.0 * dist.reshape(-1) / self.cutoff - 1.0, -1.0, 1.0)
+        out = [jnp.ones_like(x), x]
+        for _ in range(2, self.num_basis):
+            out.append(2.0 * x * out[-1] - out[-2])
+        return jnp.stack(out[: self.num_basis], axis=-1)
+
+
+def shifted_softplus(x: Array) -> Array:
+    """SchNet's activation: softplus(x) - log(2)."""
+    return jax.nn.softplus(x) - math.log(2.0)

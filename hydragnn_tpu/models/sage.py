@@ -1,0 +1,34 @@
+"""GraphSAGE conv stack (reference ``hydragnn/models/SAGEStack.py:21-47``,
+PyG ``SAGEConv`` with mean aggregation):
+h_i' = W_root x_i + W_nbr mean_j x_j."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from ..graphs.graph import GraphBatch
+from ..graphs import segment
+from .base import register_conv
+
+
+@register_conv("SAGE")
+class SAGEConv(nn.Module):
+    spec: ModelSpec
+    layer: int
+    out_dim: int | None = None
+
+    @nn.compact
+    def __call__(
+        self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
+    ):
+        hidden = self.out_dim or self.spec.hidden_dim
+        msg = inv[batch.senders] * batch.edge_mask[:, None]
+        # masked mean: sum of real messages / real in-degree
+        agg_sum = segment.segment_sum(msg, batch.receivers, batch.num_nodes)
+        deg = segment.segment_sum(batch.edge_mask, batch.receivers, batch.num_nodes)
+        agg = agg_sum / jnp.maximum(deg, 1.0)[:, None]
+        out = nn.Dense(hidden, name="lin_root")(inv) + nn.Dense(hidden, name="lin_nbr")(agg)
+        return out, equiv

@@ -1,0 +1,89 @@
+"""SchNet conv stack (reference ``hydragnn/models/SCFStack.py:42-301``):
+continuous-filter convolution — filters are an MLP of the Gaussian-smeared
+edge length windowed by a cosine cutoff; messages are filter-gated sender
+features, sum-aggregated:
+
+    W_ij = filter_mlp(rbf(d_ij) [, e_ij]) * C(d_ij)
+    x_i' = lin2( sum_j  lin1(x_j) * W_ij )
+
+Optionally E(3)-equivariant (``equivariance`` config flag): every layer except
+the last also nudges positions along normalized edge vectors scaled by a
+coordinate MLP of the filters (``CFConv.coord_model``, ``SCFStack.py:243-250``)
+— mean-aggregated over incident edges. SchNet layers use no batch norm
+(feature layers are Identity in the reference, ``_init_conv :81-95``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from ..graphs.graph import GraphBatch
+from ..graphs import segment
+from .base import register_conv
+from .radial import GaussianSmearing, cosine_cutoff, shifted_softplus
+
+
+@register_conv("SchNet")
+class SchNetConv(nn.Module):
+    spec: ModelSpec
+    layer: int
+    out_dim: int | None = None
+
+    feature_norm = False  # reference uses Identity feature layers for SchNet
+
+    @nn.compact
+    def __call__(
+        self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
+    ):
+        spec = self.spec
+        hidden = self.out_dim or spec.hidden_dim
+        nf = spec.num_filters or 64
+        cutoff = float(spec.radius or 5.0)
+        last_layer = self.layer >= spec.num_conv_layers - 1
+        equivariant = bool(spec.equivariance) and not last_layer
+
+        vec = equiv[batch.receivers] - equiv[batch.senders] + batch.edge_shifts
+        dist = jnp.sqrt(jnp.sum(vec * vec, axis=-1) + 1e-12)
+
+        rbf = GaussianSmearing(
+            start=0.0, stop=cutoff, num_gaussians=spec.num_gaussians or 50, name="smearing"
+        )(dist)
+        if spec.edge_dim and batch.edge_attr.shape[1]:
+            rbf = jnp.concatenate([rbf, batch.edge_attr], axis=-1)
+
+        w = nn.Dense(nf, name="filter1")(rbf)
+        w = shifted_softplus(w)
+        w = nn.Dense(nf, name="filter2")(w)
+        w = w * cosine_cutoff(dist, cutoff)[:, None]
+
+        x = nn.Dense(nf, use_bias=False, name="lin1")(inv)
+        msg = x[batch.senders] * w * batch.edge_mask[:, None]
+        agg = segment.segment_sum(msg, batch.receivers, batch.num_nodes)
+        out = nn.Dense(hidden, name="lin2")(agg)
+
+        if equivariant:
+            # reference CFConv.coord_model: normalized diff (eps=1.0), scalar
+            # gate from a small MLP on the filters, mean aggregation
+            coord_gate = nn.Dense(nf, name="coord1")(w)
+            coord_gate = nn.relu(coord_gate)
+            # xavier_uniform gain=0.001 (reference SCFStack.py:221-222):
+            # variance_scaling needs scale = gain^2 = 1e-6
+            coord_gate = nn.Dense(
+                1,
+                use_bias=False,
+                kernel_init=nn.initializers.variance_scaling(1e-6, "fan_avg", "uniform"),
+                name="coord2",
+            )(coord_gate)
+            coord_diff = vec / (dist[:, None] + 1.0)
+            trans = jnp.clip(coord_diff * coord_gate, -100.0, 100.0)
+            trans = trans * batch.edge_mask[:, None]
+            # NOTE (parity): the reference aggregates at edge_index[0] == the
+            # message *sender* (EGNN convention); mean over incident edges
+            agg_t = segment.segment_sum(trans, batch.senders, batch.num_nodes)
+            cnt = segment.segment_sum(batch.edge_mask, batch.senders, batch.num_nodes)
+            equiv = equiv + agg_t / jnp.maximum(cnt, 1.0)[:, None]
+
+        return out, equiv

@@ -96,23 +96,26 @@ def make_parallel_train_step(
 ):
     """Jitted SPMD train step: (state, stacked_batch[D, ...]) -> (state, metrics)."""
 
-    def loss_fn(params, batch_stats, batches: GraphBatch):
+    def loss_fn(params, batch_stats, batches: GraphBatch, dropout_rng):
         c_params = _cast_floats(params, compute_dtype)
         c_batches = _cast_floats(batches, compute_dtype)
+        n_dev = jax.tree.leaves(batches)[0].shape[0]
+        dev_rngs = jax.random.split(dropout_rng, n_dev)
 
-        def per_device(b):
+        def per_device(b, rng):
             outputs, updates = model.apply(
                 {"params": c_params, "batch_stats": batch_stats},
                 b,
                 train=True,
                 mutable=["batch_stats"],
+                rngs={"dropout": rng},
             )
             pred = _cast_floats(outputs, jnp.float32)
             tot, tasks = model.loss(pred, b)
             ng = b.graph_mask.sum()
             return tot * ng, jnp.stack(tasks) * ng, ng, updates["batch_stats"]
 
-        tots, tasks, ngs, new_stats = jax.vmap(per_device)(c_batches)
+        tots, tasks, ngs, new_stats = jax.vmap(per_device)(c_batches, dev_rngs)
         denom = jnp.maximum(ngs.sum(), 1.0)
         loss = tots.sum() / denom
         # running stats: average replicas (reference default — SyncBatchNorm off)
@@ -121,9 +124,10 @@ def make_parallel_train_step(
 
     @jax.jit
     def train_step(state: TrainState, batches: GraphBatch):
+        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
         (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
-        )(state.params, state.batch_stats, batches)
+        )(state.params, state.batch_stats, batches, dropout_rng)
         grads = _cast_floats(grads, jnp.float32)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

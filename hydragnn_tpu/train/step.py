@@ -74,7 +74,7 @@ def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
     """Build the jitted single-device train step:
     (state, batch) -> (state, metrics dict)."""
 
-    def loss_fn(params, batch_stats, batch: GraphBatch):
+    def loss_fn(params, batch_stats, batch: GraphBatch, dropout_rng):
         c_params = _cast_floats(params, compute_dtype)
         c_batch = _cast_floats(batch, compute_dtype)
         outputs, updates = model.apply(
@@ -82,6 +82,7 @@ def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
             c_batch,
             train=True,
             mutable=["batch_stats"],
+            rngs={"dropout": dropout_rng},
         )
         pred = _cast_floats(outputs, jnp.float32)
         tot, tasks = model.loss(pred, batch)
@@ -89,8 +90,9 @@ def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
 
     @jax.jit
     def train_step(state: TrainState, batch: GraphBatch):
+        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
         (tot, (tasks, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, state.batch_stats, batch
+            state.params, state.batch_stats, batch, dropout_rng
         )
         grads = _cast_floats(grads, jnp.float32)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)

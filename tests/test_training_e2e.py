@@ -35,7 +35,7 @@ THRESHOLDS = {
 }
 
 
-def run_arch_e2e(mpnn_type, overrides=None, multihead=False, n_configs=400, epochs=60):
+def run_arch_e2e(mpnn_type, overrides=None, multihead=False, n_configs=500, epochs=100):
     cfg = copy.deepcopy(CI_CONFIG)
     arch = cfg["NeuralNetwork"]["Architecture"]
     arch["mpnn_type"] = mpnn_type
@@ -44,19 +44,25 @@ def run_arch_e2e(mpnn_type, overrides=None, multihead=False, n_configs=400, epoc
     cfg["NeuralNetwork"]["Training"]["num_epoch"] = epochs
     cfg["NeuralNetwork"]["Training"]["Optimizer"]["learning_rate"] = 0.02
     if multihead:
+        # mirror reference tests/inputs/ci_multihead.json: 4 heads
+        # (graph sum + nodal x/x2/x3), graph head upweighted 20x,
+        # node heads 2x10 MLPs, batch 16, lr 0.01
         cfg["NeuralNetwork"]["Variables_of_interest"] = {
             "input_node_features": [0],
-            "output_names": ["sum", "x", "x2"],
-            "output_index": [0, 1, 2],
-            "type": ["graph", "node", "node"],
+            "output_names": ["sum", "x", "x2", "x3"],
+            "output_index": [0, 1, 2, 3],
+            "type": ["graph", "node", "node", "node"],
             "denormalize_output": False,
         }
-        arch["task_weights"] = [1.0, 1.0, 1.0]
+        arch["task_weights"] = [20.0, 1.0, 1.0, 1.0]
+        arch["output_heads"]["graph"]["dim_sharedlayers"] = 10
         arch["output_heads"]["node"] = {
             "num_headlayers": 2,
-            "dim_headlayers": [4, 4],
+            "dim_headlayers": [10, 10],
             "type": "mlp",
         }
+        cfg["NeuralNetwork"]["Training"]["batch_size"] = 16
+        cfg["NeuralNetwork"]["Training"]["Optimizer"]["learning_rate"] = 0.01
 
     samples = deterministic_graph_data(number_configurations=n_configs, seed=7)
     state, model, aug_cfg = hydragnn_tpu.run_training(cfg, samples=samples)
@@ -76,3 +82,19 @@ def test_gin_singlehead_convergence():
 
 def test_gin_multihead_convergence():
     run_arch_e2e("GIN", multihead=True)
+
+
+ARCH_OVERRIDES = {
+    "SAGE": {},
+    "GAT": {"hidden_dim": 8},
+    "MFC": {"max_neighbours": 20},
+    "CGCNN": {},
+    "PNA": {},
+    "PNAPlus": {"num_radial": 5, "envelope_exponent": 5},
+    "SchNet": {"num_gaussians": 20, "num_filters": 16},
+}
+
+
+@pytest.mark.parametrize("arch", sorted(ARCH_OVERRIDES))
+def test_invariant_arch_convergence(arch):
+    run_arch_e2e(arch, overrides=ARCH_OVERRIDES[arch], multihead=True)
