@@ -311,6 +311,9 @@ class ModelSpec:
     use_graph_attr_conditioning: bool = False
     graph_attr_conditioning_mode: str = "concat_node"
     enable_interatomic_potential: bool = False
+    energy_weight: float = 0.0
+    energy_peratom_weight: float = 0.0
+    force_weight: float = 0.0
     freeze_conv_layers: bool = False
     initial_bias: float | None = None
     conv_checkpointing: bool = False
@@ -403,6 +406,9 @@ class ModelSpec:
             use_graph_attr_conditioning=bool(arch.get("use_graph_attr_conditioning", False)),
             graph_attr_conditioning_mode=arch.get("graph_attr_conditioning_mode", "concat_node"),
             enable_interatomic_potential=bool(arch.get("enable_interatomic_potential", False)),
+            energy_weight=float(arch.get("energy_weight", 0.0)),
+            energy_peratom_weight=float(arch.get("energy_peratom_weight", 0.0)),
+            force_weight=float(arch.get("force_weight", 0.0)),
             freeze_conv_layers=bool(arch.get("freeze_conv_layers", False)),
             initial_bias=arch.get("initial_bias"),
             conv_checkpointing=bool(training.get("conv_checkpointing", False)),

@@ -118,8 +118,10 @@ class HydraModel(nn.Module):
         use_feature_norm = getattr(conv_cls, "feature_norm", True)
         if spec.conv_checkpointing:
             # trade recompute for HBM: rematerialize each conv block on backward
-            # (reference uses torch checkpointing at Base.py:714-721)
-            conv_cls = nn.remat(conv_cls)
+            # (reference uses torch checkpointing at Base.py:714-721).
+            # `train` (argnum 4 counting the module receiver) must stay static:
+            # convs branch on it in Python (dropout determinism).
+            conv_cls = nn.remat(conv_cls, static_argnums=(4,))
         self.graph_convs = [
             conv_cls(spec=spec, layer=i) for i in range(spec.num_conv_layers)
         ]
@@ -222,7 +224,7 @@ class HydraModel(nn.Module):
         inv, equiv = self.embed(batch)
         act = get_activation(self.spec.activation)
         for conv, norm in zip(self.graph_convs, self.feature_layers):
-            inv, equiv = conv(inv, equiv, batch, train=train)
+            inv, equiv = conv(inv, equiv, batch, train)  # positional: remat statics
             if norm is not None:
                 inv = norm(inv, batch.node_mask, train)
             inv = act(inv)

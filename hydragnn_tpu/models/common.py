@@ -159,3 +159,39 @@ def local_node_index(batch_ids: Array, n_node: Array, num_nodes: int) -> Array:
     """
     offsets = jnp.concatenate([jnp.zeros((1,), n_node.dtype), jnp.cumsum(n_node)[:-1]])
     return jnp.arange(num_nodes, dtype=batch_ids.dtype) - offsets[batch_ids]
+
+
+def equivariant_coordinate_update(
+    edge_feat: Array,
+    coord_diff: Array,
+    senders: Array,
+    edge_mask: Array,
+    num_nodes: int,
+    hidden: int,
+    tanh_bound: bool,
+    name_prefix: str = "coord",
+) -> Array:
+    """Shared E(3) coordinate-update block used by EGNN and SchNet
+    (reference ``E_GCL.coord_model`` / ``CFConv.coord_model``): per-edge scalar
+    gate MLP (final layer xavier_uniform gain=0.001 == variance_scaling 1e-6),
+    optional tanh bound, +/-100 clip, padding mask, sender-mean aggregation.
+    Returns the per-node position delta [N, 3].
+    """
+    from ..graphs import segment
+
+    # must be called from inside a @nn.compact __call__ — the Dense layers
+    # attach to the calling module's scope
+    gate = nn.Dense(hidden, name=f"{name_prefix}_mlp_0")(edge_feat)
+    gate = nn.relu(gate)
+    gate = nn.Dense(
+        1,
+        use_bias=False,
+        kernel_init=nn.initializers.variance_scaling(1e-6, "fan_avg", "uniform"),
+        name=f"{name_prefix}_mlp_out",
+    )(gate)
+    if tanh_bound:
+        gate = jnp.tanh(gate)
+    trans = jnp.clip(coord_diff * gate, -100.0, 100.0) * edge_mask[:, None]
+    agg = segment.segment_sum(trans, senders, num_nodes)
+    cnt = segment.segment_sum(edge_mask, senders, num_nodes)
+    return agg / jnp.maximum(cnt, 1.0)[:, None]

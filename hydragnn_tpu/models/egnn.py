@@ -1,0 +1,79 @@
+"""EGNN conv stack (reference ``hydragnn/models/EGCLStack.py:22-300``,
+``E_GCL`` layer): E(n)-equivariant message passing.
+
+Per layer:
+    m_ij   = edge_mlp([h_i, h_j, ||d_ij||, e_ij])
+    pos_i +=  mean_j( d_hat_ij * tanh(coord_mlp(m_ij)) )  [if equivariant,
+              skipped on the last layer — EGCLStack.get_conv :46-70]
+    h_i    = node_mlp([h_i, sum_j m_ij])
+
+Parity notes: edge vectors are normalized with eps=1.0 (reference calls
+``get_edge_vectors_and_lengths(..., normalize=True, eps=1.0)``); messages are
+aggregated at the edge *sender* (row) like the reference's
+``unsorted_segment_sum(edge_feat, row)``; PBC ``edge_shifts`` flow through the
+geometry (EGCLStack supports them, ``:111-131``); feature layers are Identity
+(no batch norm). Coordinate updates honor padding via edge masks.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from ..graphs.graph import GraphBatch
+from ..graphs import segment
+from .base import register_conv
+from .common import MLP, equivariant_coordinate_update
+
+
+@register_conv("EGNN")
+class EGNNConv(nn.Module):
+    spec: ModelSpec
+    layer: int
+    out_dim: int | None = None
+
+    feature_norm = False  # reference EGCLStack uses Identity feature layers
+
+    @nn.compact
+    def __call__(
+        self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
+    ):
+        spec = self.spec
+        hidden = spec.hidden_dim
+        out_dim = self.out_dim or hidden
+        last_layer = self.layer >= spec.num_conv_layers - 1
+        # reference default: equivariance toggles coordinate updates, off on
+        # the last layer (EGCLStack._init_conv :46-70)
+        equivariant = bool(spec.equivariance) and not last_layer
+
+        vec = equiv[batch.receivers] - equiv[batch.senders] + batch.edge_shifts
+        lengths = jnp.sqrt(jnp.sum(vec * vec, axis=-1, keepdims=True) + 1e-18)
+        coord_diff = vec / (lengths + 1.0)  # normalize=True, eps=1.0
+
+        feats = [inv[batch.senders], inv[batch.receivers], lengths]
+        if spec.edge_dim and batch.edge_attr.shape[1]:
+            feats.append(batch.edge_attr)
+        edge_in = jnp.concatenate(feats, axis=-1)
+        m = MLP(
+            features=(hidden, hidden),
+            activation=spec.activation,
+            act_last=True,
+            name="edge_mlp",
+        )(edge_in)
+
+        if equivariant:
+            equiv = equiv + equivariant_coordinate_update(
+                m, coord_diff, batch.senders, batch.edge_mask, batch.num_nodes,
+                hidden, tanh_bound=True, name_prefix="coord_mlp",
+            )
+
+        m_masked = m * batch.edge_mask[:, None]
+        agg = segment.segment_sum(m_masked, batch.senders, batch.num_nodes)
+        h = MLP(
+            features=(hidden, out_dim),
+            activation=spec.activation,
+            name="node_mlp",
+        )(jnp.concatenate([inv, agg], axis=-1))
+        return h, equiv

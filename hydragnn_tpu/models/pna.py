@@ -17,7 +17,6 @@ from ..config.schema import ModelSpec
 from ..graphs.graph import GraphBatch
 from ..graphs import segment
 from .base import register_conv
-from .common import MLP
 
 AGGREGATORS = ("mean", "min", "max", "std")
 SCALERS = ("identity", "amplification", "attenuation", "linear")
@@ -58,26 +57,20 @@ def degree_scaled_aggregate(
     they are routed to the dummy node slot already (receivers point at the
     padded node), so real-node statistics are unaffected.
     """
+    # padded edges already route to the dummy node slot, so the plain segment
+    # reductions see only real edges at real receivers (segment.py contract)
     msg_sum = msg * edge_mask[:, None]
-    outs = []
     deg = segment.segment_sum(edge_mask, receivers, num_nodes)
-    safe_deg = jnp.maximum(deg, 1.0)
+    outs = []
     for a in aggregators:
         if a == "mean":
-            outs.append(
-                segment.segment_sum(msg_sum, receivers, num_nodes) / safe_deg[:, None]
-            )
+            outs.append(segment.segment_mean(msg_sum, receivers, num_nodes))
         elif a == "min":
             outs.append(segment.segment_min(msg, receivers, num_nodes))
         elif a == "max":
             outs.append(segment.segment_max(msg, receivers, num_nodes))
         elif a == "std":
-            mean = segment.segment_sum(msg_sum, receivers, num_nodes) / safe_deg[:, None]
-            mean_sq = (
-                segment.segment_sum(msg_sum * msg, receivers, num_nodes)
-                / safe_deg[:, None]
-            )
-            outs.append(jnp.sqrt(jnp.maximum(mean_sq - mean**2, 0.0) + 1e-5))
+            outs.append(segment.segment_std(msg, receivers, num_nodes))
         elif a == "sum":
             outs.append(segment.segment_sum(msg_sum, receivers, num_nodes))
         else:

@@ -25,10 +25,9 @@ class SAGEConv(nn.Module):
         self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
     ):
         hidden = self.out_dim or self.spec.hidden_dim
+        # padded edges route to the dummy node, so segment_mean over receivers
+        # is already the masked neighbor mean for real nodes
         msg = inv[batch.senders] * batch.edge_mask[:, None]
-        # masked mean: sum of real messages / real in-degree
-        agg_sum = segment.segment_sum(msg, batch.receivers, batch.num_nodes)
-        deg = segment.segment_sum(batch.edge_mask, batch.receivers, batch.num_nodes)
-        agg = agg_sum / jnp.maximum(deg, 1.0)[:, None]
+        agg = segment.segment_mean(msg, batch.receivers, batch.num_nodes)
         out = nn.Dense(hidden, name="lin_root")(inv) + nn.Dense(hidden, name="lin_nbr")(agg)
         return out, equiv

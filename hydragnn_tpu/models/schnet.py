@@ -23,6 +23,7 @@ from ..config.schema import ModelSpec
 from ..graphs.graph import GraphBatch
 from ..graphs import segment
 from .base import register_conv
+from .common import equivariant_coordinate_update
 from .radial import GaussianSmearing, cosine_cutoff, shifted_softplus
 
 
@@ -65,25 +66,12 @@ class SchNetConv(nn.Module):
         out = nn.Dense(hidden, name="lin2")(agg)
 
         if equivariant:
-            # reference CFConv.coord_model: normalized diff (eps=1.0), scalar
-            # gate from a small MLP on the filters, mean aggregation
-            coord_gate = nn.Dense(nf, name="coord1")(w)
-            coord_gate = nn.relu(coord_gate)
-            # xavier_uniform gain=0.001 (reference SCFStack.py:221-222):
-            # variance_scaling needs scale = gain^2 = 1e-6
-            coord_gate = nn.Dense(
-                1,
-                use_bias=False,
-                kernel_init=nn.initializers.variance_scaling(1e-6, "fan_avg", "uniform"),
-                name="coord2",
-            )(coord_gate)
+            # reference CFConv.coord_model: normalized diff (eps=1.0), sender-
+            # mean aggregation (edge_index[0] convention), no tanh bound
             coord_diff = vec / (dist[:, None] + 1.0)
-            trans = jnp.clip(coord_diff * coord_gate, -100.0, 100.0)
-            trans = trans * batch.edge_mask[:, None]
-            # NOTE (parity): the reference aggregates at edge_index[0] == the
-            # message *sender* (EGNN convention); mean over incident edges
-            agg_t = segment.segment_sum(trans, batch.senders, batch.num_nodes)
-            cnt = segment.segment_sum(batch.edge_mask, batch.senders, batch.num_nodes)
-            equiv = equiv + agg_t / jnp.maximum(cnt, 1.0)[:, None]
+            equiv = equiv + equivariant_coordinate_update(
+                w, coord_diff, batch.senders, batch.edge_mask, batch.num_nodes,
+                nf, tanh_bound=False, name_prefix="coord",
+            )
 
         return out, equiv

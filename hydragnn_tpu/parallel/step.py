@@ -94,7 +94,13 @@ def put_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
 def make_parallel_train_step(
     model: HydraModel, optimizer, mesh: Mesh, compute_dtype=jnp.float32
 ):
-    """Jitted SPMD train step: (state, stacked_batch[D, ...]) -> (state, metrics)."""
+    """Jitted SPMD train step: (state, stacked_batch[D, ...]) -> (state, metrics).
+
+    Dispatches to the MLIP (energy+force) loss when the spec enables
+    interatomic potentials — same contract as the single-device path.
+    """
+    if model.spec.enable_interatomic_potential:
+        return _make_parallel_mlip_train_step(model, optimizer, mesh, compute_dtype)
 
     def loss_fn(params, batch_stats, batches: GraphBatch, dropout_rng):
         c_params = _cast_floats(params, compute_dtype)
@@ -169,3 +175,72 @@ def make_parallel_eval_step(model: HydraModel, mesh: Mesh, compute_dtype=jnp.flo
         }
 
     return eval_step
+
+
+def _make_parallel_mlip_train_step(
+    model: HydraModel, optimizer, mesh: Mesh, compute_dtype=jnp.float32
+):
+    """SPMD MLIP step: per-device inner force grad, global outer param grad."""
+    from ..models.mlip import energy_force_loss, validate_mlip_spec
+    from ..graphs import segment
+
+    spec = model.spec
+    validate_mlip_spec(spec)
+
+    def loss_fn(params, batch_stats, batches: GraphBatch, dropout_rng):
+        c_params = _cast_floats(params, compute_dtype)
+        c_batches = _cast_floats(batches, compute_dtype)
+        n_dev = jax.tree.leaves(batches)[0].shape[0]
+        dev_rngs = jax.random.split(dropout_rng, n_dev)
+
+        def per_device(b, b_raw, rng):
+            def total_energy(pos):
+                bb = b.replace(pos=pos)
+                pred, updates = model.apply(
+                    {"params": c_params, "batch_stats": batch_stats},
+                    bb,
+                    train=True,
+                    mutable=["batch_stats"],
+                    rngs={"dropout": rng},
+                )
+                if spec.var_output:
+                    pred = pred[0]
+                if spec.output_type[0] == "node":
+                    node_e = pred[0] * bb.node_mask[:, None]
+                    graph_e = segment.segment_sum(node_e[:, 0], bb.batch, bb.num_graphs)
+                else:
+                    graph_e = pred[0][:, 0]
+                graph_e = (graph_e * bb.graph_mask).astype(jnp.float32)
+                return graph_e.sum(), (graph_e, updates["batch_stats"])
+
+            (_, (graph_e, new_stats)), grad_pos = jax.value_and_grad(
+                total_energy, has_aux=True
+            )(b.pos)
+            forces = (-grad_pos * b_raw.node_mask[:, None]).astype(jnp.float32)
+            tot, tasks = energy_force_loss(spec, graph_e, forces, b_raw)
+            ng = b_raw.graph_mask.sum()
+            return tot * ng, jnp.stack(tasks) * ng, ng, new_stats
+
+        tots, tasks, ngs, new_stats = jax.vmap(per_device)(c_batches, batches, dev_rngs)
+        denom = jnp.maximum(ngs.sum(), 1.0)
+        new_stats = jax.tree.map(lambda x: x.mean(axis=0), new_stats)
+        return tots.sum() / denom, (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
+
+    @jax.jit
+    def train_step(state: TrainState, batches: GraphBatch):
+        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
+        (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.batch_stats, batches, dropout_rng)
+        grads = _cast_floats(grads, jnp.float32)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        return new_state, {"loss": loss, "tasks_loss": tasks, "num_graphs": ng}
+
+    return train_step

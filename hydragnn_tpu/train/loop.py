@@ -110,8 +110,15 @@ def train_validate_test(
     num_epoch = int(training["num_epoch"])
     precision = resolve_precision(training.get("precision", "fp32"))
 
-    train_step = make_train_step(model, optimizer, compute_dtype=precision)
-    eval_step = make_eval_step(model, compute_dtype=precision)
+    if model.spec.enable_interatomic_potential:
+        # MLIP path: energy + per-atom energy + jax.grad forces in the loss
+        from ..models.mlip import make_mlip_eval_step, make_mlip_train_step
+
+        train_step = make_mlip_train_step(model, optimizer, compute_dtype=precision)
+        eval_step = make_mlip_eval_step(model, compute_dtype=precision)
+    else:
+        train_step = make_train_step(model, optimizer, compute_dtype=precision)
+        eval_step = make_eval_step(model, compute_dtype=precision)
 
     scheduler = ReduceLROnPlateau(get_learning_rate(state.opt_state))
     checkpoint = (

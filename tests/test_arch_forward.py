@@ -18,7 +18,7 @@ from hydragnn_tpu.preprocess import apply_variables_of_interest
 
 from test_config import CI_CONFIG
 
-INVARIANT_ARCHS = ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus", "SchNet"]
+INVARIANT_ARCHS = ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus", "SchNet", "EGNN"]
 
 
 def build_arch(mpnn_type, extra=None):

@@ -1,0 +1,244 @@
+"""MLIP force tests: analytic-force parity, equivariance, and training.
+
+Reference counterparts: ``tests/test_forces_equivariant.py`` (F(Rx) = R F(x)
+across system geometries), ``test_forces_equivariant_training.py`` (LJ
+training then equivariance), ``test_interatomic_potential.py`` (loss
+composition).
+"""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.datasets.lennard_jones import lennard_jones_data, lj_energy_forces
+from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+from hydragnn_tpu.graphs.radius import radius_graph
+from hydragnn_tpu.models import create_model_config, init_model
+from hydragnn_tpu.models.mlip import (
+    energy_force_loss,
+    make_energy_and_forces,
+    make_mlip_eval_step,
+    make_mlip_train_step,
+    validate_mlip_spec,
+)
+from hydragnn_tpu.preprocess import apply_variables_of_interest
+
+MLIP_CONFIG = {
+    "Verbosity": {"level": 0},
+    "Dataset": {
+        "name": "LJ_mlip",
+        "format": "unit_test",
+        "normalize": False,
+        "node_features": {"name": ["type"], "dim": [1], "column_index": [0]},
+        "graph_features": {"name": ["energy"], "dim": [1], "column_index": [0]},
+    },
+    "NeuralNetwork": {
+        "Architecture": {
+            "mpnn_type": "EGNN",
+            "radius": 5.0,
+            "max_neighbours": 100,
+            "hidden_dim": 16,
+            "num_conv_layers": 2,
+            "equivariance": True,
+            "enable_interatomic_potential": True,
+            "activation_function": "silu",
+            "energy_weight": 1.0,
+            "energy_peratom_weight": 0.0,
+            "force_weight": 10.0,
+            "graph_pooling": "add",
+            "output_heads": {
+                "node": {"num_headlayers": 2, "dim_headlayers": [16, 16], "type": "mlp"}
+            },
+            "task_weights": [1.0],
+        },
+        "Variables_of_interest": {
+            "input_node_features": [0],
+            "output_index": [0],
+            "type": ["node"],
+            "output_dim": [1],
+            "denormalize_output": False,
+        },
+        "Training": {
+            "num_epoch": 2,
+            "perc_train": 0.8,
+            "loss_function_type": "mse",
+            "batch_size": 8,
+            "Optimizer": {"type": "AdamW", "learning_rate": 0.005},
+        },
+    },
+}
+
+
+def build_mlip(arch="EGNN", n_samples=16, head_type="node"):
+    cfg = copy.deepcopy(MLIP_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["mpnn_type"] = arch
+    if head_type == "graph":
+        cfg["NeuralNetwork"]["Variables_of_interest"]["type"] = ["graph"]
+        cfg["NeuralNetwork"]["Architecture"]["output_heads"] = {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 8,
+                "num_headlayers": 1,
+                "dim_headlayers": [8],
+            }
+        }
+    samples = lennard_jones_data(number_configurations=n_samples, cells_per_dim=2, seed=3)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 4)
+    batch = jax.tree.map(jnp.asarray, collate(samples[:4], pad))
+    return model, batch, cfg, samples
+
+
+def test_lj_analytic_forces_match_numerical():
+    """The LJ fixture's analytic forces must equal -dE/dpos numerically."""
+    samples = lennard_jones_data(number_configurations=1, cells_per_dim=2, seed=1)
+    s = samples[0]
+    eps = 1e-5
+    # float64 accumulator: E/(2*eps) intermediates are ~1e7 and would quantize
+    # away the force signal in float32
+    f_num = np.zeros(s.pos.shape, np.float64)
+    # keep the neighbor list FIXED under perturbation: the truncated-LJ energy
+    # is discontinuous at the cutoff, and the analytic forces are defined for
+    # the fixed graph (same contract the model trains under)
+    pos64 = s.pos.astype(np.float64)
+    shifts64 = s.edge_shifts.astype(np.float64)
+    for i in [0, 3]:  # spot-check two atoms
+        for d in range(3):
+            for sign in (+1, -1):
+                p = pos64.copy()
+                p[i, d] += sign * eps
+                e, _ = lj_energy_forces(p, s.senders, s.receivers, shifts64)
+                f_num[i, d] += -sign * e / (2 * eps)
+    np.testing.assert_allclose(f_num[0], s.forces_y[0], rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(f_num[3], s.forces_y[3], rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("head_type", ["node", "graph"])
+def test_model_forces_are_energy_gradients(head_type):
+    """F = -dE/dpos: finite-difference check through the model."""
+    model, batch, cfg, _ = build_mlip(head_type=head_type)
+    variables = init_model(model, batch)
+    eaf = make_energy_and_forces(model)
+    graph_e, forces = eaf(variables, batch)
+    assert np.all(np.isfinite(np.asarray(forces)))
+
+    from hydragnn_tpu.models.mlip import make_graph_energy_fn
+
+    energy_fn = make_graph_energy_fn(model)
+    # eps large enough to beat float32 energy-difference noise; the grad
+    # itself is exact (autodiff), this only sanity-checks the wiring
+    eps = 1e-2
+    for (i, d) in [(0, 0), (2, 1)]:
+        pos_p = batch.pos.at[i, d].add(eps)
+        pos_m = batch.pos.at[i, d].add(-eps)
+        e_p = float(energy_fn(variables, pos_p, batch).sum())
+        e_m = float(energy_fn(variables, pos_m, batch).sum())
+        f_num = -(e_p - e_m) / (2 * eps)
+        np.testing.assert_allclose(float(forces[i, d]), f_num, rtol=2e-2, atol=1e-4)
+
+
+def test_force_equivariance_egnn():
+    """F(Rx) = R F(x) for a rigid rotation of the whole system (reference
+    tests/test_forces_equivariant.py)."""
+    model, batch, cfg, samples = build_mlip()
+    variables = init_model(model, batch)
+    eaf = make_energy_and_forces(model)
+    _, f0 = eaf(variables, batch)
+
+    # random rotation
+    rng = np.random.default_rng(5)
+    A = rng.normal(size=(3, 3))
+    Q, _ = np.linalg.qr(A)
+    if np.linalg.det(Q) < 0:
+        Q[:, 0] *= -1
+    R = jnp.asarray(Q, jnp.float32)
+
+    batch_rot = batch.replace(
+        pos=batch.pos @ R.T, edge_shifts=batch.edge_shifts @ R.T
+    )
+    e0, _ = eaf(variables, batch)
+    e1, f1 = eaf(variables, batch_rot)
+    # energy invariant
+    np.testing.assert_allclose(np.asarray(e0), np.asarray(e1), rtol=1e-4, atol=1e-5)
+    # forces rotate
+    np.testing.assert_allclose(
+        np.asarray(f1), np.asarray(f0 @ R.T), rtol=1e-3, atol=1e-5
+    )
+
+
+def test_energy_force_loss_composition():
+    model, batch, cfg, _ = build_mlip()
+    variables = init_model(model, batch)
+    eaf = make_energy_and_forces(model)
+    graph_e, forces = eaf(variables, batch)
+    tot, tasks = energy_force_loss(model.spec, graph_e, forces, batch)
+    assert len(tasks) == 3  # energy, energy/atom, force
+    expected = 1.0 * tasks[0] + 0.0 * tasks[1] + 10.0 * tasks[2]
+    np.testing.assert_allclose(float(tot), float(expected), rtol=1e-6)
+
+
+def test_mlip_validation_rejects_bad_specs():
+    model, batch, cfg, _ = build_mlip(head_type="graph")
+    # mean pooling with graph head must be rejected
+    import dataclasses
+
+    bad = dataclasses.replace(model.spec, graph_pooling="mean")
+    with pytest.raises(ValueError):
+        validate_mlip_spec(bad)
+    bad2 = dataclasses.replace(
+        model.spec, energy_weight=0.0, energy_peratom_weight=0.0, force_weight=0.0
+    )
+    with pytest.raises(ValueError):
+        validate_mlip_spec(bad2)
+
+
+def test_mlip_training_reduces_force_error():
+    """Short LJ training run: force loss must drop (reference
+    test_forces_equivariant_training.py trains LJ then checks)."""
+    import hydragnn_tpu
+
+    cfg = copy.deepcopy(MLIP_CONFIG)
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 80
+    cfg["NeuralNetwork"]["Training"]["Optimizer"]["learning_rate"] = 0.002
+    samples = lennard_jones_data(number_configurations=60, cells_per_dim=2, seed=11)
+    # normalize energies to a trainable scale
+    energies = np.array([s.energy_y[0] for s in samples])
+    e_mean, e_std = energies.mean(), energies.std() + 1e-9
+    f_std = np.concatenate([s.forces_y for s in samples]).std() + 1e-9
+    for s in samples:
+        s.energy_y = (s.energy_y - e_mean) / e_std
+        s.forces_y = s.forces_y / e_std
+    state, model, aug = hydragnn_tpu.run_training(cfg, samples=samples)
+
+    eval_step = make_mlip_eval_step(model)
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.train import create_train_state, select_optimizer
+
+    loader = GraphLoader(samples, 8)
+
+    def split_rmse(st):
+        sse = cnt = None
+        for b in loader:
+            m = eval_step(st, jax.tree.map(jnp.asarray, b))
+            s = np.asarray(m["head_sse"], np.float64)
+            c = np.asarray(m["head_count"], np.float64)
+            sse = s if sse is None else sse + s
+            cnt = c if cnt is None else cnt + c
+        return np.sqrt(sse / cnt)
+
+    trained = split_rmse(state)
+    opt = select_optimizer(aug["NeuralNetwork"]["Training"]["Optimizer"])
+    fresh = create_train_state(model, opt, next(iter(loader)))
+    untrained = split_rmse(fresh)
+    assert np.all(np.isfinite(trained))
+    # training must clearly beat the untrained model on forces (the exact
+    # ratio is init-seed sensitive; 0.8 is robust across seeds)
+    assert trained[1] < 0.8 * untrained[1], (
+        f"force RMSE {trained[1]:.3f} vs untrained {untrained[1]:.3f}"
+    )

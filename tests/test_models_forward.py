@@ -203,3 +203,23 @@ def test_run_training_defaults_missing_batch_size():
     samples = deterministic_graph_data(number_configurations=40, seed=5)
     state, model, aug = hydragnn_tpu.run_training(cfg, samples=samples)
     assert aug["NeuralNetwork"]["Training"]["batch_size"] == 32
+
+
+def test_conv_checkpointing_with_dropout_arch():
+    """Regression: nn.remat must keep `train` static — GAT (which branches on
+    train for dropout) used to crash under conv_checkpointing."""
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["mpnn_type"] = "GAT"
+    cfg["NeuralNetwork"]["Training"]["conv_checkpointing"] = True
+    samples = deterministic_graph_data(number_configurations=6, seed=5)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 4)
+    batch = jax.tree.map(jnp.asarray, collate(samples[:4], pad))
+    variables = init_model(model, batch)
+    out, _ = model.apply(
+        variables, batch, train=True, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    assert np.all(np.isfinite(np.asarray(out[0])))

@@ -131,3 +131,31 @@ def test_parallel_eval_step():
     m = eval_step(state, sb)
     rmse = np.sqrt(np.asarray(m["head_sse"]) / np.asarray(m["head_count"]))
     assert np.all(np.isfinite(rmse))
+
+
+def test_parallel_mlip_step_dispatch():
+    """SPMD train step must run the MLIP loss when interatomic potentials are
+    enabled (regression: it used to silently fall back to the standard loss)."""
+    import copy
+    from test_forces import MLIP_CONFIG
+    from hydragnn_tpu.datasets.lennard_jones import lennard_jones_data
+    from hydragnn_tpu.preprocess import apply_variables_of_interest
+
+    cfg = copy.deepcopy(MLIP_CONFIG)
+    samples = lennard_jones_data(number_configurations=16, cells_per_dim=2, seed=2)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    opt = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+
+    pad = compute_pad_spec(samples, 2)
+    batches = [collate(samples[i * 2 : (i + 1) * 2], pad) for i in range(8)]
+    mesh = make_mesh()
+    state = shard_state(create_train_state(model, opt, batches[0]), mesh)
+    step = make_parallel_train_step(model, opt, mesh)
+    sb = put_batch(stack_device_batches(batches), mesh)
+    state2, metrics = step(state, sb)
+    # MLIP metrics carry 3 task losses: energy, energy/atom, force
+    assert metrics["tasks_loss"].shape == (3,)
+    assert np.isfinite(float(metrics["loss"]))

@@ -92,6 +92,7 @@ ARCH_OVERRIDES = {
     "PNA": {},
     "PNAPlus": {"num_radial": 5, "envelope_exponent": 5},
     "SchNet": {"num_gaussians": 20, "num_filters": 16},
+    "EGNN": {},
 }
 
 
