@@ -30,18 +30,20 @@ def _round_up(value: int, multiple: int) -> int:
 
 
 class PadSpec:
-    """A static padding bucket: (n_node, n_edge, n_graph) with n_graph
-    including the trailing dummy padding graph."""
+    """A static padding bucket: (n_node, n_edge, n_graph[, n_triplet]) with
+    n_graph including the trailing dummy padding graph. ``n_triplet`` is 0
+    unless the pipeline attaches DimeNet triplets."""
 
-    __slots__ = ("n_node", "n_edge", "n_graph")
+    __slots__ = ("n_node", "n_edge", "n_graph", "n_triplet")
 
-    def __init__(self, n_node: int, n_edge: int, n_graph: int):
+    def __init__(self, n_node: int, n_edge: int, n_graph: int, n_triplet: int = 0):
         self.n_node = int(n_node)
         self.n_edge = int(n_edge)
         self.n_graph = int(n_graph)
+        self.n_triplet = int(n_triplet)
 
-    def as_tuple(self) -> tuple[int, int, int]:
-        return (self.n_node, self.n_edge, self.n_graph)
+    def as_tuple(self) -> tuple[int, int, int, int]:
+        return (self.n_node, self.n_edge, self.n_graph, self.n_triplet)
 
     def __eq__(self, other) -> bool:
         return isinstance(other, PadSpec) and self.as_tuple() == other.as_tuple()
@@ -50,7 +52,10 @@ class PadSpec:
         return hash(self.as_tuple())
 
     def __repr__(self) -> str:
-        return f"PadSpec(n_node={self.n_node}, n_edge={self.n_edge}, n_graph={self.n_graph})"
+        return (
+            f"PadSpec(n_node={self.n_node}, n_edge={self.n_edge}, "
+            f"n_graph={self.n_graph}, n_triplet={self.n_triplet})"
+        )
 
 
 def compute_pad_spec(
@@ -67,7 +72,18 @@ def compute_pad_spec(
     max_edges = max((s.num_edges for s in samples), default=1)
     n_node = _round_up(int(max_nodes * batch_size * slack) + 1, node_multiple)
     n_edge = _round_up(int(max_edges * batch_size * slack) + 1, edge_multiple)
-    return PadSpec(n_node=n_node, n_edge=n_edge, n_graph=batch_size + 1)
+    max_triplets = max(
+        (s.extras["idx_kj"].shape[0] for s in samples if "idx_kj" in s.extras),
+        default=0,
+    )
+    n_triplet = (
+        _round_up(int(max_triplets * batch_size * slack), edge_multiple)
+        if max_triplets
+        else 0
+    )
+    return PadSpec(
+        n_node=n_node, n_edge=n_edge, n_graph=batch_size + 1, n_triplet=n_triplet
+    )
 
 
 def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
@@ -113,9 +129,20 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
     graph_mask = np.zeros((G,), np.float32)
     n_node = np.zeros((G,), np.int32)
     dataset_id = np.zeros((G,), np.int32)
+    T = pad.n_triplet
+    # padded triplets point at the last (padded) edge slot
+    idx_kj = np.full((T,), E - 1, np.int32)
+    idx_ji = np.full((T,), E - 1, np.int32)
+    triplet_mask = np.zeros((T,), np.float32)
+    tot_triplets = sum(
+        s.extras.get("idx_kj", np.zeros(0)).shape[0] for s in samples
+    )
+    if tot_triplets > T:
+        raise ValueError(f"batch has {tot_triplets} triplets, bucket holds {T}")
 
     node_off = 0
     edge_off = 0
+    trip_off = 0
     for g, s in enumerate(samples):
         n, e = s.num_nodes, s.num_edges
         x[node_off : node_off + n] = s.x
@@ -139,6 +166,14 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
         graph_mask[g] = 1.0
         n_node[g] = n
         dataset_id[g] = s.dataset_id
+        if T and "idx_kj" in s.extras:
+            kj = s.extras["idx_kj"]
+            ji = s.extras["idx_ji"]
+            t = kj.shape[0]
+            idx_kj[trip_off : trip_off + t] = kj + edge_off
+            idx_ji[trip_off : trip_off + t] = ji + edge_off
+            triplet_mask[trip_off : trip_off + t] = 1.0
+            trip_off += t
         node_off += n
         edge_off += e
 
@@ -148,6 +183,7 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
         graph_y=graph_y, node_y=node_y, energy_y=energy_y, forces_y=forces_y,
         node_mask=node_mask, edge_mask=edge_mask, graph_mask=graph_mask,
         n_node=n_node, dataset_id=dataset_id,
+        idx_kj=idx_kj, idx_ji=idx_ji, triplet_mask=triplet_mask,
     )
 
 

@@ -55,6 +55,9 @@ class GraphBatch(NamedTuple):
     - ``graph_mask``:[G]        1.0 for real graphs
     - ``n_node``:   [G]         real node count per graph (0 for padding)
     - ``dataset_id``:[G]        multidataset branch id per graph (int32)
+    - ``idx_kj``/``idx_ji``:[T] triplet edge-index pairs (DimeNet angles;
+      zero-length unless the pipeline attaches triplets)
+    - ``triplet_mask``:[T]      1.0 for real triplets
     """
 
     x: Array
@@ -74,6 +77,9 @@ class GraphBatch(NamedTuple):
     graph_mask: Array
     n_node: Array
     dataset_id: Array
+    idx_kj: Array
+    idx_ji: Array
+    triplet_mask: Array
 
     # -- static helpers -------------------------------------------------------
     @property

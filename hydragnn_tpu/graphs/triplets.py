@@ -1,0 +1,63 @@
+"""Triplet (angle) indexing for directional message passing — DimeNet.
+
+Reference: ``hydragnn/models/DIMEStack.py:233-281`` (``triplets()`` adapted
+from PyG): for every edge (j -> i) enumerate all edges (k -> j) with k != i;
+the interaction block mixes edge embeddings along these (kj) -> (ji) pairs
+weighted by the spherical basis of the angle at j.
+
+TPU design: triplets are *host-side preprocessing* (numpy) computed once per
+sample and padded to a static bucket by ``collate`` — never inside jit. The
+angle itself is computed on device from the padded edge vectors (it depends on
+positions, which change under force training).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .graph import GraphSample
+
+
+def build_triplets(senders: np.ndarray, receivers: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Edge-index pairs (idx_kj, idx_ji): for each directed edge ji = (j -> i)
+    and each edge kj = (k -> j), k != i. Returns arrays of edge ids."""
+    senders = np.asarray(senders)
+    receivers = np.asarray(receivers)
+    E = senders.shape[0]
+    if E == 0:
+        z = np.zeros((0,), np.int32)
+        return z, z
+    # incoming edge lists per node: edges whose receiver is n
+    order = np.argsort(receivers, kind="stable")
+    sorted_recv = receivers[order]
+    # boundaries of each receiver group
+    starts = np.searchsorted(sorted_recv, np.arange(receivers.max() + 2))
+    idx_kj_list = []
+    idx_ji_list = []
+    for ji in range(E):
+        j = senders[ji]
+        i = receivers[ji]
+        if j >= len(starts) - 1:
+            continue
+        group = order[starts[j] : starts[j + 1]]  # edges k -> j
+        if group.size == 0:
+            continue
+        keep = senders[group] != i  # k != i
+        kj = group[keep]
+        idx_kj_list.append(kj)
+        idx_ji_list.append(np.full(kj.shape, ji, np.int64))
+    if not idx_kj_list:
+        z = np.zeros((0,), np.int32)
+        return z, z
+    return (
+        np.concatenate(idx_kj_list).astype(np.int32),
+        np.concatenate(idx_ji_list).astype(np.int32),
+    )
+
+
+def attach_triplets(sample: GraphSample) -> GraphSample:
+    """Compute and cache triplet indices on a sample (idempotent)."""
+    idx_kj, idx_ji = build_triplets(sample.senders, sample.receivers)
+    sample.extras["idx_kj"] = idx_kj
+    sample.extras["idx_ji"] = idx_ji
+    return sample

@@ -88,6 +88,8 @@ def degree_scaled_aggregate(
             scaled.append(agg * (delta / jnp.maximum(log_deg, 1e-6))[:, None])
         elif s == "linear":
             scaled.append(agg * (deg / max(avg_deg_lin or 1.0, 1e-6))[:, None])
+        elif s == "inverse_linear":
+            scaled.append(agg * ((avg_deg_lin or 1.0) / jnp.maximum(deg, 1.0))[:, None])
         else:
             raise ValueError(f"unknown scaler {s}")
     return jnp.concatenate(scaled, axis=-1)  # [N, A*S*F]

@@ -1,0 +1,113 @@
+"""PNAEq conv stack (reference ``hydragnn/models/PNAEqStack.py:41-538``):
+PaiNN-style scalar+vector channels where scalar messages are aggregated with
+the PNA degree-scaled multi-aggregator (mean/min/max/std x identity/
+amplification/attenuation/linear/inverse_linear) instead of a plain sum.
+
+Per layer: message (Bessel rbf embed -> pre-MLP on [x_i, x_j, rbf(+edge)] ->
+tanh/silu scalar MLP -> rbf-gated split into vector/edge gates + scalar
+message; scalar degree-aggregated at the sender, vector sum-aggregated;
+residual) then the shared PainnUpdate, then the output-size embeddings.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.schema import ModelSpec
+from ..graphs.graph import GraphBatch
+from ..graphs import segment
+from .base import register_conv
+from .painn import PainnUpdate
+from .pna import avg_degree_linear, degree_scaled_aggregate, log_degree_mean
+from .radial import BesselBasis
+
+PNAEQ_AGGREGATORS = ("mean", "min", "max", "std")
+PNAEQ_SCALERS = ("identity", "amplification", "attenuation", "linear", "inverse_linear")
+
+
+@register_conv("PNAEq")
+class PNAEqConv(nn.Module):
+    spec: ModelSpec
+    layer: int
+    out_dim: int | None = None
+
+    feature_norm = False  # reference PNAEqStack uses Identity feature layers
+
+    @nn.compact
+    def __call__(
+        self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
+    ):
+        spec = self.spec
+        out_dim = self.out_dim or spec.hidden_dim
+        ns = inv.shape[-1]
+        last_layer = self.layer >= spec.num_conv_layers - 1
+        delta = log_degree_mean(spec.pna_deg or [0, 1])
+        avg_lin = max(avg_degree_linear(spec.pna_deg or [0, 1]), 1.0)
+
+        if equiv.ndim == 2:
+            v = jnp.zeros((batch.num_nodes, 3, ns), inv.dtype)
+        else:
+            v = equiv
+
+        vec = batch.pos[batch.receivers] - batch.pos[batch.senders] + batch.edge_shifts
+        dist = jnp.sqrt(jnp.sum(vec * vec, axis=-1) + 1e-18)
+        unit_vec = vec / (dist[:, None] + 1e-9)
+
+        rbf = BesselBasis(
+            num_radial=spec.num_radial or 6,
+            cutoff=float(spec.radius or 5.0),
+            envelope_exponent=spec.envelope_exponent or 5,
+            name="rbf",
+        )(dist)
+
+        # pre-MLP on concatenated endpoint scalars + rbf embed (+ edge attr)
+        rbf_attr = jnp.tanh(nn.Dense(ns, name="rbf_emb")(rbf))
+        feats = [inv[batch.senders], inv[batch.receivers], rbf_attr]
+        if spec.edge_dim and batch.edge_attr.shape[1]:
+            feats.append(nn.Dense(ns, name="edge_encoder")(batch.edge_attr))
+        h = jnp.concatenate(feats, axis=-1)
+        h = nn.Dense(ns, name="pre_nn")(h)
+
+        # scalar message MLP (tanh stabilized) and rbf gating
+        m = nn.Dense(ns, name="scalar_mlp_0")(h)
+        m = jnp.tanh(m)
+        m = nn.Dense(ns, name="scalar_mlp_1")(m)
+        m = nn.silu(m)
+        m = nn.Dense(ns * 3, name="scalar_mlp_2")(m)
+        m = m * nn.Dense(ns * 3, use_bias=False, name="rbf_lin")(rbf)
+
+        gate_v, gate_edge, msg_s = jnp.split(m, 3, axis=-1)
+        v_msg = (
+            v[batch.receivers] * gate_v[:, None, :]
+            + gate_edge[:, None, :] * unit_vec[:, :, None]
+        )
+
+        # scalar: degree-scaled aggregation at the sender + post MLP
+        agg = degree_scaled_aggregate(
+            msg_s * batch.edge_mask[:, None],
+            batch.senders,
+            batch.edge_mask,
+            batch.num_nodes,
+            delta,
+            aggregators=PNAEQ_AGGREGATORS,
+            scalers=PNAEQ_SCALERS,
+            avg_deg_lin=avg_lin,
+        )
+        delta_x = nn.Dense(ns, name="post_nn")(jnp.concatenate([inv, agg], axis=-1))
+        dv = segment.segment_sum(
+            v_msg * batch.edge_mask[:, None, None], batch.senders, batch.num_nodes
+        )
+        s = inv + delta_x
+        v = v + dv
+
+        s, v = PainnUpdate(node_size=ns, last_layer=last_layer, name="update")(s, v)
+
+        s = nn.Dense(out_dim, name="node_embed_0")(s)
+        s = jnp.tanh(s)
+        s = nn.Dense(out_dim, name="node_embed_1")(s)
+        if not last_layer:
+            v = nn.Dense(out_dim, use_bias=False, name="vec_embed")(v)
+        return s, v

@@ -192,6 +192,15 @@ def dataset_loading_and_splitting(config: dict, samples=None, rank: int = 0, wor
         samples = load_raw_dataset(config)
     training = config.setdefault("NeuralNetwork", {}).setdefault("Training", {})
     samples = apply_variables_of_interest(samples, config)
+    if (
+        config["NeuralNetwork"].get("Architecture", {}).get("mpnn_type") == "DimeNet"
+    ):
+        # DimeNet needs host-precomputed angle (triplet) indices
+        from ..graphs.triplets import attach_triplets
+
+        for s in samples:
+            if "idx_kj" not in s.extras:
+                attach_triplets(s)
     if config["NeuralNetwork"]["Variables_of_interest"].get("denormalize_output") or config[
         "Dataset"
     ].get("normalize", True):

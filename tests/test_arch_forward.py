@@ -19,6 +19,7 @@ from hydragnn_tpu.preprocess import apply_variables_of_interest
 from test_config import CI_CONFIG
 
 INVARIANT_ARCHS = ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus", "SchNet", "EGNN"]
+EQUIVARIANT_ARCHS = ["PAINN", "PNAEq", "DimeNet"]
 
 
 def build_arch(mpnn_type, extra=None):
@@ -45,6 +46,11 @@ def build_arch(mpnn_type, extra=None):
     }
     samples = deterministic_graph_data(number_configurations=8, seed=13)
     samples = apply_variables_of_interest(samples, cfg)
+    if mpnn_type == "DimeNet":
+        from hydragnn_tpu.graphs.triplets import attach_triplets
+
+        for s in samples:
+            attach_triplets(s)
     cfg = update_config(cfg, samples)
     model = create_model_config(cfg)
     pad = compute_pad_spec(samples, 4)
@@ -52,7 +58,7 @@ def build_arch(mpnn_type, extra=None):
     return model, batch
 
 
-@pytest.mark.parametrize("arch", INVARIANT_ARCHS)
+@pytest.mark.parametrize("arch", INVARIANT_ARCHS + EQUIVARIANT_ARCHS)
 def test_arch_forward_and_grad(arch):
     model, batch = build_arch(arch)
     variables = init_model(model, batch)
@@ -115,3 +121,52 @@ def test_schnet_equivariant_updates_positions():
     # positions moved for real nodes (equivariant coordinate updates active)
     moved = np.abs(np.asarray(equiv - batch.pos))[np.asarray(batch.node_mask) > 0]
     assert moved.max() > 0
+
+
+def test_spherical_bessel_matches_scipy():
+    """The hand-rolled stable j_l must match scipy to float32 precision over
+    the full argument range DimeNet uses (regression: upward recurrence
+    overflowed at padded zero-length edges; j_0-only normalization broke at
+    its zeros)."""
+    from scipy import special
+
+    from hydragnn_tpu.models.spherical import _spherical_jn
+
+    x = np.linspace(0.05, 30.0, 1200).astype(np.float32)
+    ours = _spherical_jn(6, jnp.asarray(x))
+    for l in range(7):
+        ref = special.spherical_jn(l, x)
+        assert np.abs(np.asarray(ours[l]) - ref).max() < 2e-4
+
+
+def test_painn_scalar_invariance_under_rotation():
+    """PaiNN scalar outputs must be invariant to rigid rotations."""
+    model, batch = build_arch("PAINN")
+    variables = init_model(model, batch)
+    out0 = model.apply(variables, batch, train=False)
+    rng = np.random.default_rng(2)
+    Q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(Q) < 0:
+        Q[:, 0] *= -1
+    R = jnp.asarray(Q, jnp.float32)
+    batch_rot = batch.replace(pos=batch.pos @ R.T, edge_shifts=batch.edge_shifts @ R.T)
+    out1 = model.apply(variables, batch_rot, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out0[0]), np.asarray(out1[0]), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_dimenet_invariance_under_rotation():
+    model, batch = build_arch("DimeNet")
+    variables = init_model(model, batch)
+    out0 = model.apply(variables, batch, train=False)
+    rng = np.random.default_rng(4)
+    Q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(Q) < 0:
+        Q[:, 0] *= -1
+    R = jnp.asarray(Q, jnp.float32)
+    batch_rot = batch.replace(pos=batch.pos @ R.T, edge_shifts=batch.edge_shifts @ R.T)
+    out1 = model.apply(variables, batch_rot, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out0[0]), np.asarray(out1[0]), rtol=1e-3, atol=1e-4
+    )

@@ -242,3 +242,22 @@ def test_mlip_training_reduces_force_error():
     assert trained[1] < 0.8 * untrained[1], (
         f"force RMSE {trained[1]:.3f} vs untrained {untrained[1]:.3f}"
     )
+
+
+def test_dimenet_position_gradients_finite():
+    """Regression: padded-triplet arctan2(0,0) used to give NaN dE/dpos,
+    silently breaking DimeNet MLIP force training."""
+    from test_arch_forward import build_arch
+
+    model, batch = build_arch("DimeNet")
+    variables = init_model(model, batch)
+
+    def energy(pos):
+        out = model.apply(variables, batch.replace(pos=pos), train=False)
+        return (out[0][:, 0] * batch.graph_mask).sum()
+
+    g = jax.grad(energy)(batch.pos)
+    assert np.all(np.isfinite(np.asarray(g))), "NaN position gradients"
+    # real nodes actually feel forces
+    real = np.asarray(batch.node_mask) > 0
+    assert np.abs(np.asarray(g))[real].max() > 0

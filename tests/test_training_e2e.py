@@ -93,6 +93,18 @@ ARCH_OVERRIDES = {
     "PNAPlus": {"num_radial": 5, "envelope_exponent": 5},
     "SchNet": {"num_gaussians": 20, "num_filters": 16},
     "EGNN": {},
+    "PAINN": {"num_radial": 6, "hidden_dim": 8},
+    "PNAEq": {"num_radial": 6, "hidden_dim": 8},
+    "DimeNet": {
+        "num_radial": 6,
+        "num_spherical": 7,
+        "int_emb_size": 32,
+        "basis_emb_size": 8,
+        "out_emb_size": 16,
+        "num_before_skip": 1,
+        "num_after_skip": 2,
+        "envelope_exponent": 5,
+    },
 }
 
 
