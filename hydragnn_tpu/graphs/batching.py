@@ -139,6 +139,11 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
     )
     if tot_triplets > T:
         raise ValueError(f"batch has {tot_triplets} triplets, bucket holds {T}")
+    # pe width is taken from the first sample; samples lacking 'pe' are
+    # zero-filled below (mixed datasets where only some sources carry PEs)
+    pe_dim = first.extras["pe"].shape[1] if "pe" in first.extras else 0
+    pe = np.zeros((N, pe_dim), np.float32)
+    rel_pe = np.zeros((E, pe_dim), np.float32)
 
     node_off = 0
     edge_off = 0
@@ -166,6 +171,9 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
         graph_mask[g] = 1.0
         n_node[g] = n
         dataset_id[g] = s.dataset_id
+        if pe_dim and "pe" in s.extras:
+            pe[node_off : node_off + n] = s.extras["pe"]
+            rel_pe[edge_off : edge_off + e] = s.extras["rel_pe"]
         if T and "idx_kj" in s.extras:
             kj = s.extras["idx_kj"]
             ji = s.extras["idx_ji"]
@@ -184,6 +192,7 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
         node_mask=node_mask, edge_mask=edge_mask, graph_mask=graph_mask,
         n_node=n_node, dataset_id=dataset_id,
         idx_kj=idx_kj, idx_ji=idx_ji, triplet_mask=triplet_mask,
+        pe=pe, rel_pe=rel_pe,
     )
 
 

@@ -58,6 +58,9 @@ class GraphBatch(NamedTuple):
     - ``idx_kj``/``idx_ji``:[T] triplet edge-index pairs (DimeNet angles;
       zero-length unless the pipeline attaches triplets)
     - ``triplet_mask``:[T]      1.0 for real triplets
+    - ``pe``:       [N, K]      Laplacian positional encodings (GPS; width 0
+      unless the pipeline attaches them)
+    - ``rel_pe``:   [E, K]      relative edge encodings |pe_i - pe_j|
     """
 
     x: Array
@@ -80,6 +83,8 @@ class GraphBatch(NamedTuple):
     idx_kj: Array
     idx_ji: Array
     triplet_mask: Array
+    pe: Array
+    rel_pe: Array
 
     # -- static helpers -------------------------------------------------------
     @property

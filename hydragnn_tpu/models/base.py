@@ -115,7 +115,23 @@ class HydraModel(nn.Module):
     def setup(self):
         spec = self.spec
         conv_cls = CONV_REGISTRY[spec.mpnn_type]
+        # stack flags always come from the architecture's own conv class,
+        # even when GPS wraps it (the reference keeps Identity feature layers
+        # for SchNet/MACE/etc. with or without GPS)
         use_feature_norm = getattr(conv_cls, "feature_norm", True)
+        if spec.global_attn_engine == "GPS":
+            # wrap every conv layer in local-MPNN + global attention
+            # (reference Base._apply_global_attn, Base.py:234-247)
+            from .gps import GPSConv as conv_cls  # noqa: F811
+
+            self.pos_emb = nn.Dense(spec.hidden_dim, use_bias=False, name="pos_emb")
+            if spec.input_dim:
+                self.node_emb = nn.Dense(
+                    spec.hidden_dim, use_bias=False, name="node_emb"
+                )
+                self.node_lin = nn.Dense(
+                    spec.hidden_dim, use_bias=False, name="node_lin"
+                )
         if spec.conv_checkpointing:
             # trade recompute for HBM: rematerialize each conv block on backward
             # (reference uses torch checkpointing at Base.py:714-721).
@@ -221,18 +237,44 @@ class HydraModel(nn.Module):
     # -- encoder ------------------------------------------------------------
     def encode(self, batch: GraphBatch, train: bool = False):
         """Run the conv stack; returns (node_features, equiv_features)."""
+        conv_cls = CONV_REGISTRY[self.spec.mpnn_type]
+        # MACE: no inter-layer activation; heads read concatenated per-layer
+        # scalars (our static-shape take on the reference's summed per-layer
+        # readout decoders, MACEStack.forward :375-421)
+        stack_activation = getattr(conv_cls, "stack_activation", True)
+        collect = getattr(conv_cls, "collect_layer_outputs", False)
+
         inv, equiv = self.embed(batch)
         act = get_activation(self.spec.activation)
+        layer_outs = []
         for conv, norm in zip(self.graph_convs, self.feature_layers):
             inv, equiv = conv(inv, equiv, batch, train)  # positional: remat statics
             if norm is not None:
                 inv = norm(inv, batch.node_mask, train)
-            inv = act(inv)
+            if stack_activation:
+                inv = act(inv)
+            if collect:
+                layer_outs.append(inv)
+        if collect:
+            inv = jnp.concatenate(layer_outs, axis=-1)
         return inv, equiv
 
     def embed(self, batch: GraphBatch):
-        """Stack-specific input embedding hook; default: raw features +
-        positions (subclass stacks override via their conv's first layer)."""
+        """Input embedding. With GPS, node features and Laplacian positional
+        encodings are embedded to hidden_dim and fused (reference Base.py
+        :203-215); otherwise raw features + positions pass through (each
+        stack's first conv layer does its own lifting)."""
+        if self.spec.global_attn_engine == "GPS":
+            if batch.pe.shape[1] == 0:
+                raise ValueError(
+                    "GPS needs Laplacian positional encodings; set pe_dim > 0 "
+                    "and attach them in preprocessing (attach_lap_pe)"
+                )
+            x = self.pos_emb(batch.pe)
+            if self.spec.input_dim:
+                x = jnp.concatenate([self.node_emb(batch.x), x], axis=1)
+                x = self.node_lin(x)
+            return x, batch.pos
         return batch.x, batch.pos
 
     def pool(self, x: Array, batch: GraphBatch) -> Array:

@@ -1,0 +1,172 @@
+"""Real spherical harmonics + Gaunt (real-CG) tensor products — the
+hand-rolled replacement for e3nn that MACE needs.
+
+Reference: ``hydragnn/models/MACEStack.py`` uses ``e3nn.o3.SphericalHarmonics``
+and tensor products whose Clebsch-Gordan contractions come from
+``utils/model/mace_utils/tools/cg.py:94`` (``U_matrix_real``). Here:
+
+* ``spherical_harmonics(vec, l_max)`` — explicit Cartesian polynomial
+  formulas up to l=3 (differentiable jnp, component normalization: the l=0
+  value is 1 and each block has ||Y_l||^2 = 2l+1 on the unit sphere);
+* Gaunt coefficients G^{l3}_{l1 l2}[m1, m2, m3] = ∫ Y_{l1 m1} Y_{l2 m2}
+  Y_{l3 m3} dΩ computed ONCE on host by *exact* Gauss-Legendre x uniform-phi
+  quadrature (the integrand is a polynomial on the sphere) — this makes the
+  coupling self-consistent with our harmonics convention by construction, no
+  sympy table matching needed;
+* ``tensor_product`` — channel-wise equivariant product of two irreps
+  dictionaries ``{l: [N, 2l+1, C]}`` through the Gaunt coupling.
+
+Equivariance of the whole pipeline is asserted by rotation tests at the model
+level (MACE scalar outputs invariant, forces equivariant).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Real spherical harmonics (component normalization), m ordered -l..l
+# ---------------------------------------------------------------------------
+
+
+def _sh_blocks(x, y, z, l_max: int, xp):
+    """Shared implementation for jnp (device) and numpy (host quadrature)."""
+    out = [xp.stack([xp.ones_like(x)], axis=-1)]  # l=0: [.., 1]
+    if l_max >= 1:
+        c1 = math.sqrt(3.0)
+        out.append(xp.stack([c1 * y, c1 * z, c1 * x], axis=-1))
+    if l_max >= 2:
+        c = math.sqrt(15.0)
+        c20 = math.sqrt(5.0)
+        out.append(
+            xp.stack(
+                [
+                    c * x * y,
+                    c * y * z,
+                    c20 * 0.5 * (3.0 * z * z - 1.0),
+                    c * x * z,
+                    c * 0.5 * (x * x - y * y),
+                ],
+                axis=-1,
+            )
+        )
+    if l_max >= 3:
+        out.append(
+            xp.stack(
+                [
+                    math.sqrt(35.0 / 8.0) * y * (3.0 * x * x - y * y),
+                    math.sqrt(105.0) * x * y * z,
+                    math.sqrt(21.0 / 8.0) * y * (5.0 * z * z - 1.0),
+                    math.sqrt(7.0) * 0.5 * z * (5.0 * z * z - 3.0),
+                    math.sqrt(21.0 / 8.0) * x * (5.0 * z * z - 1.0),
+                    math.sqrt(105.0) * 0.5 * z * (x * x - y * y),
+                    math.sqrt(35.0 / 8.0) * x * (x * x - 3.0 * y * y),
+                ],
+                axis=-1,
+            )
+        )
+    if l_max >= 4:
+        raise NotImplementedError("spherical harmonics implemented up to l=3")
+    return out
+
+
+def spherical_harmonics(vec: jax.Array, l_max: int, eps: float = 1e-6) -> list:
+    """Unit-normalize ``vec`` [E, 3] and return [Y_0, ..., Y_lmax], each
+    [E, 2l+1]. Zero vectors (padding) are substituted with the +z pole BEFORE
+    the norm so gradients stay finite (sqrt at 0 has a NaN derivative and
+    0 * NaN defeats downstream masking)."""
+    n2 = jnp.sum(vec * vec, axis=-1, keepdims=True)
+    is_zero = n2 < eps * eps
+    safe_vec = jnp.where(is_zero, jnp.array([0.0, 0.0, 1.0]), vec)
+    n = jnp.sqrt(jnp.sum(safe_vec * safe_vec, axis=-1, keepdims=True))
+    unit = safe_vec / n
+    return _sh_blocks(unit[..., 0], unit[..., 1], unit[..., 2], l_max, jnp)
+
+
+# ---------------------------------------------------------------------------
+# Gaunt coefficients by exact quadrature (host, cached)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _quadrature(l_max_total: int):
+    """Gauss-Legendre in cos(theta) x uniform phi — exact for spherical
+    polynomials up to the triple-product degree."""
+    n_theta = 2 * l_max_total + 4
+    n_phi = 4 * l_max_total + 5
+    ct, wt = np.polynomial.legendre.leggauss(n_theta)
+    phi = np.arange(n_phi) * (2.0 * np.pi / n_phi)
+    st = np.sqrt(1.0 - ct**2)
+    x = st[:, None] * np.cos(phi)[None, :]
+    y = st[:, None] * np.sin(phi)[None, :]
+    z = np.broadcast_to(ct[:, None], x.shape)
+    w = np.broadcast_to(wt[:, None], x.shape) * (2.0 * np.pi / n_phi)
+    return x.ravel(), y.ravel(), z.ravel(), w.ravel()
+
+
+@functools.lru_cache(maxsize=None)
+def gaunt(l1: int, l2: int, l3: int) -> tuple:
+    """G[m1, m2, m3] = (1/4pi) ∫ Y_{l1 m1} Y_{l2 m2} Y_{l3 m3} dΩ in the
+    component-normalized basis above. Zero unless |l1-l2| <= l3 <= l1+l2 and
+    l1+l2+l3 even. Returned as a nested tuple (hashable, cached)."""
+    x, y, z, w = _quadrature(l1 + l2 + l3)
+    blocks = _sh_blocks(x, y, z, max(l1, l2, l3), np)
+    Y1, Y2, Y3 = blocks[l1], blocks[l2], blocks[l3]  # [Q, 2l+1]
+    G = np.einsum("q,qa,qb,qc->abc", w / (4.0 * np.pi), Y1, Y2, Y3)
+    G[np.abs(G) < 1e-12] = 0.0
+    return tuple(map(lambda m: tuple(map(tuple, m)), G))
+
+
+def gaunt_array(l1: int, l2: int, l3: int) -> np.ndarray:
+    return np.asarray(gaunt(l1, l2, l3))
+
+
+def coupling_paths(l_in1: int, l_in2: int, l_out_max: int) -> list:
+    """All (l1, l2, l3) with nonzero Gaunt coupling within the given maxima."""
+    paths = []
+    for l1 in range(l_in1 + 1):
+        for l2 in range(l_in2 + 1):
+            for l3 in range(abs(l1 - l2), min(l1 + l2, l_out_max) + 1):
+                if (l1 + l2 + l3) % 2 == 0:
+                    paths.append((l1, l2, l3))
+    return paths
+
+
+def tensor_product(
+    u: dict, v: dict, l_out_max: int, weights: dict | None = None
+) -> dict:
+    """Channel-wise equivariant product of irreps dicts {l: [..., 2l+1, C]}.
+
+    out[l3][..., m3, c] = sum_{l1 l2 m1 m2} w[(l1,l2,l3)][..., c] *
+                          G[m1,m2,m3] u[l1][..., m1, c] v[l2][..., m2, c]
+
+    ``weights`` maps path -> per-channel (broadcastable) weights; None = 1.
+    Channel-wise (depthwise) like MACE's symmetric contraction — channel mixing
+    happens in the surrounding linear layers.
+    """
+    out: dict[int, jax.Array] = {}
+    for l1, ul in u.items():
+        for l2, vl in v.items():
+            for l3 in range(abs(l1 - l2), min(l1 + l2, l_out_max) + 1):
+                if (l1 + l2 + l3) % 2 != 0:
+                    continue
+                G = jnp.asarray(gaunt_array(l1, l2, l3), ul.dtype)
+                term = jnp.einsum("abc,...ax,...bx->...cx", G, ul, vl)
+                if weights is not None:
+                    term = term * weights[(l1, l2, l3)]
+                out[l3] = out.get(l3, 0) + term
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Misc irreps helpers
+# ---------------------------------------------------------------------------
+
+
+def irreps_dim(l_max: int) -> int:
+    return (l_max + 1) ** 2

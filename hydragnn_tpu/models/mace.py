@@ -1,0 +1,217 @@
+"""MACE conv stack — higher-order equivariant message passing.
+
+Reference: ``hydragnn/models/MACEStack.py:74-577`` +
+``hydragnn/utils/model/mace_utils/modules/blocks.py`` (RadialEmbeddingBlock,
+RealAgnosticAttResidualInteractionBlock, EquivariantProductBasisBlock) and the
+Clebsch-Gordan symmetric contraction
+(``mace_utils/modules/symmetric_contraction.py:29-242``, ``tools/cg.py:94``).
+
+TPU-native redesign (capability parity, not a weight-for-weight port):
+
+* irreps features are dicts {l: [N, 2l+1, C]} flowing between layers packed
+  into one flat array (the CombineBlock/SplitBlock analog);
+* spherical-harmonic edge attributes and all CG couplings come from
+  ``harmonics.py`` — Gaunt coefficients by exact quadrature, channel-wise
+  tensor products (validated equivariant to float32 precision);
+* the interaction block gathers sender features, applies per-edge
+  radial-MLP-weighted TP paths with the edge harmonics, aggregates at the
+  receiver / avg_num_neighbors, with an element-gated residual (the
+  "agnostic residual" skip);
+* the product basis builds correlation-order nu features by iterated
+  channel-wise Gaunt products (B_1 = A, B_nu = TP(B_{nu-1}, A)) with learned
+  per-path weights and element gates — spanning the same symmetric n-body
+  space as the reference's U-matrix contraction with a mildly overcomplete
+  parameterization;
+* node attributes are one-hot atomic numbers over the full periodic table
+  (Z in 1..118, ``MACEStack :510-541``), read from the first input feature
+  column;
+* per-layer readouts: the stack exposes every layer's scalars to the heads
+  (``collect_layer_outputs``) instead of summing per-layer decoders.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelSpec
+from ..graphs.graph import GraphBatch
+from ..graphs import segment
+from .base import register_conv
+from .harmonics import coupling_paths, spherical_harmonics, tensor_product
+
+
+def _pack_equiv(feats: dict, l_max: int) -> jax.Array:
+    """{l: [N, 2l+1, C]} for l=1..l_max -> [N, sum(2l+1), C] (3-D on purpose:
+    MACE detects the first layer by equiv.ndim == 2 == raw positions)."""
+    return jnp.concatenate([feats[l] for l in range(1, l_max + 1)], axis=1)
+
+
+def _unpack_equiv(equiv: jax.Array, l_max: int) -> dict:
+    feats = {}
+    off = 0
+    for l in range(1, l_max + 1):
+        feats[l] = equiv[:, off : off + 2 * l + 1, :]
+        off += 2 * l + 1
+    return feats
+from .radial import BesselBasis, ChebyshevBasis, GaussianSmearing, polynomial_cutoff
+
+NUM_ELEMENTS = 119  # Z in 0..118; index 0 absorbs non-integer/unknown types
+
+
+class IrrepsLinear(nn.Module):
+    """Per-l channel-mixing linear (e3nn o3.Linear equivalent): each l block
+    gets its own [C_in, C_out] matrix; only l=0 may carry a bias."""
+
+    channels: int
+    l_max: int
+    bias: bool = False
+
+    @nn.compact
+    def __call__(self, feats: dict) -> dict:
+        out = {}
+        for l in range(self.l_max + 1):
+            if l not in feats:
+                continue
+            w = self.param(
+                f"w{l}",
+                nn.initializers.lecun_normal(),
+                (feats[l].shape[-1], self.channels),
+            )
+            y = jnp.einsum("nmc,cd->nmd", feats[l], w)
+            if l == 0 and self.bias:
+                y = y + self.param(f"b{l}", nn.initializers.zeros, (self.channels,))
+            out[l] = y
+        return out
+
+
+@register_conv("MACE")
+class MACEConv(nn.Module):
+    spec: ModelSpec
+    layer: int
+    out_dim: int | None = None
+
+    feature_norm = False  # reference: no batch norm between MACE layers
+    stack_activation = False  # reference forward applies no activation either
+    collect_layer_outputs = True  # heads see all layers' scalars
+
+    @nn.compact
+    def __call__(
+        self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
+    ):
+        spec = self.spec
+        C = max(spec.hidden_dim, 2)
+        out_c = self.out_dim or spec.hidden_dim
+        max_ell = 1 if spec.max_ell is None else spec.max_ell  # sh order
+        node_ell = 1 if spec.node_max_ell is None else spec.node_max_ell
+        last_layer = self.layer >= spec.num_conv_layers - 1
+        # first layer receives raw positions [N, 3]; later layers receive the
+        # 3-D packed irreps [N, sum(2l+1), C] from pack_irreps
+        first_layer = equiv.ndim == 2
+        out_ell = 0 if last_layer else node_ell
+        correlation = spec.correlation
+        if correlation is None:
+            correlation = 2
+        if isinstance(correlation, (list, tuple)):
+            correlation = int(correlation[min(self.layer, len(correlation) - 1)])
+        avg_nbr = float(spec.avg_num_neighbors or 1.0)
+
+        # --- node features as irreps dict ---
+        if first_layer:
+            feats = {0: nn.Dense(C, name="node_embedding")(inv)[:, None, :]}
+        else:
+            feats = {0: inv[:, None, :]}
+            feats.update(_unpack_equiv(equiv, node_ell))
+        feats = IrrepsLinear(C, node_ell, bias=True, name="linear_up")(feats)
+
+        # --- node attributes: one-hot Z + element embedding gate ---
+        z = jnp.clip(jnp.round(batch.x[:, 0]).astype(jnp.int32), 0, NUM_ELEMENTS - 1)
+        elem_gate = nn.Embed(NUM_ELEMENTS, C, name="element_embed")(z)  # [N, C]
+
+        # --- edge attributes ---
+        vec = batch.pos[batch.receivers] - batch.pos[batch.senders] + batch.edge_shifts
+        dist = jnp.sqrt(jnp.sum(vec * vec, axis=-1) + 1e-18)
+        Y = spherical_harmonics(vec, max_ell)  # list of [E, 2l+1]
+        r_max = float(spec.radius or 5.0)
+        num_radial = spec.num_radial or 8
+        rt = (spec.radial_type or "bessel").lower()
+        if rt == "bessel":
+            rbf = BesselBasis(num_radial=num_radial, cutoff=r_max, name="rbf")(dist)
+        elif rt == "chebyshev":
+            rbf = ChebyshevBasis(num_basis=num_radial, cutoff=r_max, name="rbf")(dist)
+        elif rt == "gaussian":
+            rbf = GaussianSmearing(stop=r_max, num_gaussians=num_radial, name="rbf")(dist)
+        else:
+            raise ValueError(f"unknown radial_type '{rt}'")
+        rbf = rbf * polynomial_cutoff(dist, r_max)[:, None]
+
+        # --- interaction: radial-weighted TP with edge harmonics ---
+        # messages keep l <= node_ell even on the last layer: the product
+        # basis needs them before the sizing layer trims to scalars
+        paths = coupling_paths(node_ell, max_ell, node_ell)
+        rm = max(math.ceil(C / 3.0), 4)
+        h = rbf
+        for i in range(3):  # radial_MLP = [ceil(C/3)] * 3 (MACEStack :290-293)
+            h = nn.silu(nn.Dense(rm, name=f"radial_mlp_{i}")(h))
+        path_w = nn.Dense(len(paths) * C, use_bias=False, name="radial_out")(h)
+        path_w = path_w.reshape(-1, len(paths), C)  # [E, P, C]
+
+        sender_feats = {l: f[batch.senders] for l, f in feats.items()}
+        sh = {l: Y[l][:, :, None] for l in range(max_ell + 1)}  # [E, 2l+1, 1]
+        weights = {
+            p: path_w[:, i, None, :] * batch.edge_mask[:, None, None]
+            for i, p in enumerate(paths)
+        }
+        msgs = tensor_product(sender_feats, sh, node_ell, weights)
+        agg = {
+            l: segment.segment_sum(m, batch.receivers, batch.num_nodes) / avg_nbr
+            for l, m in msgs.items()
+        }
+        agg = IrrepsLinear(C, node_ell, name="linear_post")(agg)
+
+        # --- residual skip (element-gated, the "agnostic residual" TP) ---
+        sc = IrrepsLinear(C, node_ell, name="skip_tp")(feats)
+        sc = {l: f * elem_gate[:, None, :] for l, f in sc.items()}
+
+        # --- product basis: iterated symmetric Gaunt products ---
+        # `prod` accumulates over ALL l up to node_ell: correlation products
+        # can reach l-blocks the first-order messages don't have (e.g.
+        # max_ell=1 messages coupling to l=2 at nu=2)
+        prod: dict[int, jax.Array] = {}
+        B = agg
+        for nu in range(1, correlation + 1):
+            if nu > 1:
+                wts = {
+                    p: self.param(
+                        f"prod_w{nu}_{p[0]}{p[1]}{p[2]}",
+                        nn.initializers.normal(1.0 / math.sqrt(nu)),
+                        (C,),
+                    )
+                    for p in coupling_paths(node_ell, node_ell, node_ell)
+                }
+                B = tensor_product(B, agg, node_ell, wts)
+            contrib = IrrepsLinear(C, node_ell, name=f"prod_linear_{nu}")(B)
+            for l, c in contrib.items():
+                if l <= node_ell:
+                    term = c * elem_gate[:, None, :]
+                    prod[l] = prod[l] + term if l in prod else term
+
+        # first layer has scalar-only inputs, so the skip lacks l>0 blocks
+        out = {l: prod[l] + sc[l] if l in sc else prod[l] for l in prod}
+
+        # --- sizing to output channels + split ---
+        # zero-fill any l blocks unreachable this layer (e.g. scalar-only
+        # first-layer inputs with max_ell < node_ell) so the packed layout
+        # stays static across layers
+        dtype = out[0].dtype
+        for l in range(out_ell + 1):
+            if l not in out:
+                out[l] = jnp.zeros((batch.num_nodes, 2 * l + 1, C), dtype)
+        out = IrrepsLinear(out_c, out_ell, name="sizing")(out)
+        inv_out = out[0][:, 0, :]
+        if last_layer or out_ell == 0:
+            return inv_out, batch.pos  # scalars only (reference last layer)
+        return inv_out, _pack_equiv(out, out_ell)

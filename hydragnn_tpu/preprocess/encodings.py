@@ -1,0 +1,46 @@
+"""Positional encodings for GPS global attention (host-side preprocessing).
+
+Reference: ``hydragnn/preprocess/serialized_dataset_loader.py:90,183-189`` —
+PyG ``AddLaplacianEigenvectorPE(k=pe_dim)`` per sample plus relative edge
+encodings ``rel_pe = |pe_src - pe_dst|``. numpy implementation: eigenvectors
+of the symmetric-normalized graph Laplacian, skipping the trivial constant
+eigenvector, sign-fixed for determinism, zero-padded when the graph has fewer
+than k+1 nodes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graphs.graph import GraphSample
+
+
+def laplacian_pe(senders, receivers, num_nodes: int, k: int) -> np.ndarray:
+    """k smallest non-trivial eigenvectors of the normalized Laplacian."""
+    A = np.zeros((num_nodes, num_nodes))
+    A[senders, receivers] = 1.0
+    A = np.maximum(A, A.T)  # symmetrize
+    deg = A.sum(axis=1)
+    dinv = 1.0 / np.sqrt(np.maximum(deg, 1e-12))
+    L = np.eye(num_nodes) - (dinv[:, None] * A * dinv[None, :])
+    vals, vecs = np.linalg.eigh(L)
+    order = np.argsort(vals)
+    pe = vecs[:, order[1 : k + 1]]  # skip the trivial eigenvector
+    if pe.shape[1] < k:
+        pe = np.pad(pe, ((0, 0), (0, k - pe.shape[1])))
+    # deterministic sign: make the largest-|.| entry of each vector positive
+    for j in range(pe.shape[1]):
+        i = np.argmax(np.abs(pe[:, j]))
+        if pe[i, j] < 0:
+            pe[:, j] = -pe[:, j]
+    return pe.astype(np.float32)
+
+
+def attach_lap_pe(sample: GraphSample, k: int) -> GraphSample:
+    """Compute and cache pe/rel_pe on a sample (idempotent)."""
+    if "pe" in sample.extras and sample.extras["pe"].shape[1] == k:
+        return sample
+    pe = laplacian_pe(sample.senders, sample.receivers, sample.num_nodes, k)
+    sample.extras["pe"] = pe
+    sample.extras["rel_pe"] = np.abs(pe[sample.senders] - pe[sample.receivers])
+    return sample

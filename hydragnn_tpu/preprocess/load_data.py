@@ -192,15 +192,23 @@ def dataset_loading_and_splitting(config: dict, samples=None, rank: int = 0, wor
         samples = load_raw_dataset(config)
     training = config.setdefault("NeuralNetwork", {}).setdefault("Training", {})
     samples = apply_variables_of_interest(samples, config)
-    if (
-        config["NeuralNetwork"].get("Architecture", {}).get("mpnn_type") == "DimeNet"
-    ):
+    arch_cfg = config["NeuralNetwork"].get("Architecture", {})
+    if arch_cfg.get("mpnn_type") == "DimeNet":
         # DimeNet needs host-precomputed angle (triplet) indices
         from ..graphs.triplets import attach_triplets
 
         for s in samples:
             if "idx_kj" not in s.extras:
                 attach_triplets(s)
+    if arch_cfg.get("global_attn_engine") == "GPS":
+        # GPS needs Laplacian positional encodings (reference
+        # serialized_dataset_loader.py:183-189); without GPS nothing reads
+        # them, so don't pay the per-sample eigendecomposition
+        from .encodings import attach_lap_pe
+
+        k = int(arch_cfg.get("pe_dim") or 1)
+        for s in samples:
+            attach_lap_pe(s, k)
     if config["NeuralNetwork"]["Variables_of_interest"].get("denormalize_output") or config[
         "Dataset"
     ].get("normalize", True):

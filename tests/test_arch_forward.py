@@ -19,7 +19,7 @@ from hydragnn_tpu.preprocess import apply_variables_of_interest
 from test_config import CI_CONFIG
 
 INVARIANT_ARCHS = ["GIN", "SAGE", "GAT", "MFC", "CGCNN", "PNA", "PNAPlus", "SchNet", "EGNN"]
-EQUIVARIANT_ARCHS = ["PAINN", "PNAEq", "DimeNet"]
+EQUIVARIANT_ARCHS = ["PAINN", "PNAEq", "DimeNet", "MACE"]
 
 
 def build_arch(mpnn_type, extra=None):
@@ -170,3 +170,83 @@ def test_dimenet_invariance_under_rotation():
     np.testing.assert_allclose(
         np.asarray(out0[0]), np.asarray(out1[0]), rtol=1e-3, atol=1e-4
     )
+
+
+def test_mace_invariance_under_rotation():
+    model, batch = build_arch(
+        "MACE",
+        extra={"max_ell": 2, "node_max_ell": 2, "correlation": 3,
+               "num_radial": 6, "radial_type": "bessel"},
+    )
+    variables = init_model(model, batch)
+    out0 = model.apply(variables, batch, train=False)
+    rng = np.random.default_rng(6)
+    Q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(Q) < 0:
+        Q[:, 0] *= -1
+    R = jnp.asarray(Q, jnp.float32)
+    batch_rot = batch.replace(pos=batch.pos @ R.T, edge_shifts=batch.edge_shifts @ R.T)
+    out1 = model.apply(variables, batch_rot, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out0[0]), np.asarray(out1[0]), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_mace_force_gradients_finite_and_equivariant():
+    model, batch = build_arch("MACE", extra={"max_ell": 1, "node_max_ell": 1})
+    variables = init_model(model, batch)
+
+    def energy(pos, shifts):
+        o = model.apply(
+            variables, batch.replace(pos=pos, edge_shifts=shifts), train=False
+        )
+        return (o[0][:, 0] * batch.graph_mask).sum()
+
+    g = jax.grad(energy)(batch.pos, batch.edge_shifts)
+    assert np.all(np.isfinite(np.asarray(g)))
+    rng = np.random.default_rng(7)
+    Q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(Q) < 0:
+        Q[:, 0] *= -1
+    R = jnp.asarray(Q, jnp.float32)
+    g_rot = jax.grad(energy)(batch.pos @ R.T, batch.edge_shifts @ R.T)
+    scale = max(float(jnp.abs(g).max()), 1e-9)
+    assert float(jnp.abs(g_rot - g @ R.T).max()) / scale < 1e-4
+
+
+def test_mace_propagates_vector_features_between_layers():
+    """Regression: MACE's first-layer detection once matched every layer
+    (2-D packed equiv), silently degenerating to scalar-only message passing.
+    Layer >= 1 must take the unpack branch — i.e. have NO node_embedding
+    param — and rotating inputs must change the (equivariant) hidden vector
+    features while scalars stay invariant."""
+    model, batch = build_arch(
+        "MACE", extra={"max_ell": 1, "node_max_ell": 1, "num_conv_layers": 3}
+    )
+    variables = init_model(model, batch)
+    p = variables["params"]
+    assert "node_embedding" in p["graph_convs_0"]
+    assert "node_embedding" not in p["graph_convs_1"], (
+        "layer 1 re-embedded scalars: vector features are being dropped"
+    )
+    assert "node_embedding" not in p["graph_convs_2"]
+
+
+def test_mace_correlation_reaches_higher_l():
+    """Regression: the product basis must emit l-blocks reachable only via
+    correlation products (max_ell=1 messages coupling to l=2 at nu=2)."""
+    model, batch = build_arch(
+        "MACE",
+        extra={"max_ell": 1, "node_max_ell": 2, "correlation": 2,
+               "num_conv_layers": 2},
+    )
+    variables = init_model(model, batch)
+    bound = model.bind(variables)
+    inv, equiv = bound.encode(batch, train=False)
+    # equiv packs l=1 (3 rows) + l=2 (5 rows)... returned from layer 0 to
+    # layer 1; check the final layer consumed a nonzero l=2 block by checking
+    # the layer-0 output directly
+    conv0 = bound.graph_convs[0]
+    inv0, equiv0 = conv0(*bound.embed(batch), batch, False)
+    l2_block = equiv0[:, 3:8, :]  # rows 3..7 = l=2
+    assert float(jnp.abs(l2_block).max()) > 0, "l=2 features are all zero"

@@ -1,0 +1,133 @@
+"""GPS global attention tests: forward, same-graph masking, LapPE, training.
+
+Reference coverage: the GPS variants of ``tests/test_graphs.py`` (every arch x
+GPS) and the LapPE pipeline in ``serialized_dataset_loader.py:183-189``.
+"""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hydragnn_tpu
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.datasets import deterministic_graph_data
+from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+from hydragnn_tpu.models import create_model_config, init_model
+from hydragnn_tpu.preprocess import apply_variables_of_interest
+from hydragnn_tpu.preprocess.encodings import attach_lap_pe, laplacian_pe
+
+from test_config import CI_CONFIG
+
+
+def build_gps(mpnn_type="GIN", pe_dim=2, heads=2):
+    cfg = copy.deepcopy(CI_CONFIG)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch.update(
+        {
+            "mpnn_type": mpnn_type,
+            "global_attn_engine": "GPS",
+            "global_attn_heads": heads,
+            "pe_dim": pe_dim,
+            "num_gaussians": 10,
+            "num_filters": 8,
+            "num_radial": 5,
+        }
+    )
+    samples = deterministic_graph_data(number_configurations=8, seed=17)
+    samples = apply_variables_of_interest(samples, cfg)
+    for s in samples:
+        attach_lap_pe(s, pe_dim)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 4)
+    batch = jax.tree.map(jnp.asarray, collate(samples[:4], pad))
+    return model, batch, cfg
+
+
+def test_laplacian_pe_properties():
+    samples = deterministic_graph_data(number_configurations=2, seed=3)
+    s = samples[0]
+    pe = laplacian_pe(s.senders, s.receivers, s.num_nodes, 3)
+    assert pe.shape == (s.num_nodes, 3)
+    assert np.all(np.isfinite(pe))
+    # eigenvectors are orthogonal (non-degenerate ones)
+    gram = pe.T @ pe
+    np.testing.assert_allclose(gram, np.diag(np.diag(gram)), atol=1e-4)
+
+
+@pytest.mark.parametrize("arch", ["GIN", "SAGE", "PNA"])
+def test_gps_forward_and_grad(arch):
+    model, batch, _ = build_gps(arch)
+    variables = init_model(model, batch)
+    out = model.apply(variables, batch, train=False)
+    assert np.all(np.isfinite(np.asarray(out[0])))
+
+    def loss_fn(params):
+        pred = model.apply(
+            {"params": params, "batch_stats": variables.get("batch_stats", {})},
+            batch,
+            train=False,
+        )
+        tot, _ = model.loss(pred, batch)
+        return tot
+
+    g = jax.grad(loss_fn)(variables["params"])
+    gmax = max(float(jnp.abs(x).max()) for x in jax.tree.leaves(g))
+    assert np.isfinite(gmax) and gmax > 0
+
+
+def test_gps_attention_is_graph_local():
+    """Perturbing graph B's nodes must not change graph A's outputs."""
+    model, batch, cfg = build_gps("GIN")
+    variables = init_model(model, batch)
+    out0 = model.apply(variables, batch, train=False)
+
+    # perturb features of graph 1's nodes only
+    sel = np.asarray(batch.batch) == 1
+    x2 = np.asarray(batch.x).copy()
+    x2[sel] += 10.0
+    out1 = model.apply(variables, batch.replace(x=jnp.asarray(x2)), train=False)
+    # graph 0's prediction unchanged, graph 1's changed
+    np.testing.assert_allclose(
+        float(out0[0][0, 0]), float(out1[0][0, 0]), rtol=1e-5
+    )
+    assert abs(float(out0[0][1, 0]) - float(out1[0][1, 0])) > 1e-6
+
+
+def test_gps_end_to_end_training():
+    cfg = copy.deepcopy(CI_CONFIG)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch.update(
+        {"global_attn_engine": "GPS", "global_attn_heads": 2, "pe_dim": 2}
+    )
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 30
+    samples = deterministic_graph_data(number_configurations=200, seed=19)
+    state, model, aug = hydragnn_tpu.run_training(cfg, samples=samples)
+    err, tasks, trues, preds = hydragnn_tpu.run_prediction(
+        cfg, state, model, samples=samples
+    )
+    rmse = float(np.sqrt(np.mean((trues[0] - preds[0]) ** 2)))
+    assert rmse < 0.35, f"GPS-GIN failed to converge: RMSE {rmse:.3f}"
+
+
+def test_gps_preserves_inner_stack_norm_flags():
+    """Regression: with GPS on, feature-layer norms must follow the inner
+    arch's contract (SchNet uses Identity feature layers, GPS or not)."""
+    model, batch, _ = build_gps("SchNet")
+    variables = init_model(model, batch)
+    assert not any(
+        k.startswith("feature_norm") for k in variables["params"]
+    ), "GPS wrapper reintroduced feature norms for a no-norm architecture"
+
+
+def test_gps_edge_model_consumes_rel_pe():
+    """Edge-capable convs under GPS must receive relative-PE edge encodings
+    (regression: rel_pe used to be computed but never read)."""
+    model, batch, _ = build_gps("PNA")
+    variables = init_model(model, batch)
+    flat = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+    names = {"/".join(str(p) for p in path) for path, _ in flat}
+    assert any("rel_pos_emb" in n for n in names), "rel_pe embedding missing"

@@ -105,6 +105,14 @@ ARCH_OVERRIDES = {
         "num_after_skip": 2,
         "envelope_exponent": 5,
     },
+    "MACE": {
+        "max_ell": 1,
+        "node_max_ell": 1,
+        "correlation": 2,
+        "num_radial": 6,
+        "radial_type": "bessel",
+        "hidden_dim": 8,
+    },
 }
 
 
