@@ -1,0 +1,108 @@
+"""MD17-style equivariant training (reference ``examples/md17``): PaiNN or
+MACE on molecular-dynamics trajectories. Reads extended-XYZ frames from
+``--data`` when given (any MD17 export); otherwise generates a synthetic
+vibrating-molecule trajectory so the example runs without network access.
+
+    python examples/md17/md17.py [--arch PAINN|MACE] [--data dir] [--epochs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def synthetic_trajectory(n_frames: int, seed: int = 0):
+    from hydragnn_tpu.graphs.graph import GraphSample
+    from hydragnn_tpu.graphs.radius import radius_graph
+
+    rng = np.random.default_rng(seed)
+    # an aspirin-sized molecule: 21 atoms around equilibrium positions
+    base = rng.uniform(0, 5.0, size=(21, 3))
+    z = rng.choice([1, 6, 8], size=(21, 1)).astype(np.float64)
+    samples = []
+    for t in range(n_frames):
+        disp = 0.1 * rng.normal(size=base.shape)
+        pos = base + disp
+        s_idx, r_idx, sh = radius_graph(pos, radius=3.0, max_neighbours=20)
+        energy = float((disp**2).sum())  # harmonic well
+        samples.append(
+            GraphSample(
+                x=z, pos=pos, senders=s_idx, receivers=r_idx, edge_shifts=sh,
+                extras={"node_table": z, "graph_table": np.array([energy])},
+            )
+        )
+    return samples
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="PAINN", choices=["PAINN", "MACE", "PNAEq", "SchNet"])
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--frames", type=int, default=400)
+    args = ap.parse_args()
+
+    import hydragnn_tpu
+
+    config = {
+        "Verbosity": {"level": 1},
+        "Dataset": {
+            "name": "md17",
+            "format": "xyz",
+            "path": {"total": args.data or ""},
+            "node_features": {"name": ["Z"], "dim": [1], "column_index": [0]},
+            "graph_features": {"name": ["energy"], "dim": [1], "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": args.arch,
+                "radius": 3.0,
+                "max_neighbours": 20,
+                "hidden_dim": 32,
+                "num_conv_layers": 3,
+                "num_radial": 6,
+                "max_ell": 2,
+                "node_max_ell": 2,
+                "correlation": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 2,
+                        "dim_sharedlayers": 32,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [32, 32],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["energy"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "num_epoch": args.epochs,
+                "perc_train": 0.8,
+                "loss_function_type": "mse",
+                "batch_size": 32,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.002},
+            },
+        },
+    }
+    samples = None
+    if not args.data:
+        print("no --data; generating a synthetic MD trajectory")
+        samples = synthetic_trajectory(args.frames)
+
+    state, model, cfg = hydragnn_tpu.run_training(config, samples=samples)
+    err, tasks, trues, preds = hydragnn_tpu.run_prediction(
+        config, state, model, samples=samples
+    )
+    rmse = float(np.sqrt(np.mean((trues[0] - preds[0]) ** 2)))
+    print(f"energy RMSE: {rmse:.5f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,25 +11,41 @@ Top-level API mirrors the reference (``hydragnn/__init__.py:1-3``):
 ``run_training``, ``run_prediction`` plus subpackages.
 """
 
-from . import graphs  # noqa: F401
+import os as _os
+
+
+def _honor_platform_env() -> None:
+    """Make JAX_PLATFORMS work as documented even on hosts whose TPU plugin
+    overrides the platform list via jax.config.update in sitecustomize (the
+    env var is read before that update and otherwise silently ignored)."""
+    want = _os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+    except ImportError:
+        return
+    try:
+        # public API; a no-op (or late-update) once backends are initialized
+        jax.config.update("jax_platforms", want)
+    except RuntimeError:
+        pass  # backends already initialized — too late to change
+
+
+_honor_platform_env()
+
+from . import graphs  # noqa: F401,E402
 
 __version__ = "0.1.0"
 
 
-def __getattr__(name):
-    # Lazy imports keep `import hydragnn_tpu` light and avoid importing jax
-    # model code before test harnesses set platform env vars. Importing the
-    # submodule rebinds the package attribute to the *module*, so pin the
-    # function back into globals() to keep `hydragnn_tpu.run_training(...)`
-    # callable on every access.
-    if name == "run_training":
-        from .run_training import run_training as fn
+# Eager function imports LAST: any later `import hydragnn_tpu.run_training`
+# rebinds the package attribute to the submodule, so modules of the same name
+# must be imported before the functions shadow them (reference exports the
+# same two symbols, hydragnn/__init__.py:1-3).
+from . import run_prediction as _run_prediction_module  # noqa: E402
+from . import run_training as _run_training_module  # noqa: E402
+from .run_prediction import run_prediction  # noqa: E402,F811
+from .run_training import run_training  # noqa: E402,F811
 
-        globals()["run_training"] = fn
-        return fn
-    if name == "run_prediction":
-        from .run_prediction import run_prediction as fn
-
-        globals()["run_prediction"] = fn
-        return fn
-    raise AttributeError(f"module 'hydragnn_tpu' has no attribute '{name}'")
+__all__ = ["run_training", "run_prediction", "graphs", "__version__"]

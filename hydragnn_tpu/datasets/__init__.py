@@ -1,14 +1,50 @@
 from .synthetic import deterministic_graph_data
+from .lennard_jones import lennard_jones_data
+from .lsms import load_lsms_dir, read_lsms_file, write_lsms_file
+from .xyz import load_xyz_dir, read_xyz_file
+from .cfg import load_cfg_dir, read_cfg_file
+from .pickledataset import SimplePickleDataset, SimplePickleWriter
+from .packed import PackedDataset, PackedWriter
 
 
 def load_raw_dataset(config: dict):
     """Dispatch on ``Dataset.format`` to a raw loader (reference
-    ``transform_raw_data_to_serialized`` + per-format loaders). Formats are
-    registered as the datasets package grows (LSMS/CFG/XYZ/pickle)."""
-    fmt = config["Dataset"].get("format")
+    ``transform_raw_data_to_serialized`` + per-format loaders,
+    ``hydragnn/preprocess/raw_dataset_loader.py``)."""
+    ds = config["Dataset"]
+    fmt = (ds.get("format") or "").lower()
+    path = ds.get("path")
+    if isinstance(path, dict):
+        path = path.get("total") or next(iter(path.values()))
+    if fmt == "lsms":
+        return load_lsms_dir(path, charge_density_update=ds.get("charge_density", False))
+    if fmt == "xyz":
+        return load_xyz_dir(path)
+    if fmt == "cfg":
+        return load_cfg_dir(path)
+    if fmt == "pickle":
+        return SimplePickleDataset(path, ds.get("label", "total")).load_all()
+    if fmt == "packed":
+        return PackedDataset(path).load_all()
     raise ValueError(
-        f"Dataset format '{fmt}' has no registered loader yet; pass samples= directly"
+        f"Dataset format '{fmt}' has no registered loader; supported: "
+        "LSMS, XYZ, CFG, pickle, packed (or pass samples= directly)"
     )
 
 
-__all__ = ["deterministic_graph_data", "load_raw_dataset"]
+__all__ = [
+    "deterministic_graph_data",
+    "lennard_jones_data",
+    "load_raw_dataset",
+    "load_lsms_dir",
+    "read_lsms_file",
+    "write_lsms_file",
+    "load_xyz_dir",
+    "read_xyz_file",
+    "load_cfg_dir",
+    "read_cfg_file",
+    "SimplePickleDataset",
+    "SimplePickleWriter",
+    "PackedDataset",
+    "PackedWriter",
+]

@@ -1,0 +1,194 @@
+"""Packed-record dataset format — the scale-out data plane.
+
+Reference design: ADIOS2 .bp files with per-key concatenated global arrays,
+one ragged dimension, and ``variable_count``/``variable_offset`` index arrays
+plus global attributes (minmax, pna_deg, dataset_name) — ``hydragnn/utils/
+datasets/adiosdataset.py:48-352``. The TPU build keeps the same count/offset
+index design in a single flat file:
+
+    [8B magic 'GPKDATA1'][8B header_len][header JSON]
+    [per key: counts int64[n_samples], then concatenated row-major data]
+
+Header JSON: {"n_samples": N, "keys": [{"name", "dtype", "cols", "offset",
+"counts_offset"}...], "attrs": {...}}. Every key is a per-node/edge/graph
+array with a leading ragged dimension; scalars are 1-row keys.
+
+Reads are zero-copy ``np.memmap`` slices; per-host shard windows
+(``subset``) reproduce AdiosDataset's ``setsubset`` (``:864-890``); the
+native ``gather_blocks`` path batches many samples' rows without the GIL.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..graphs.graph import GraphSample
+
+MAGIC = b"GPKDATA1"
+
+# GraphSample fields serialized per sample: (name, dtype, trailing_cols_fn)
+_FIELDS = (
+    ("x", np.float32),
+    ("pos", np.float32),
+    ("senders", np.int32),
+    ("receivers", np.int32),
+    ("edge_attr", np.float32),
+    ("edge_shifts", np.float32),
+    ("graph_y", np.float32),
+    ("node_y", np.float32),
+    ("energy_y", np.float32),
+    ("forces_y", np.float32),
+    ("node_table", np.float32),
+    ("graph_table", np.float32),
+)
+
+
+def _field_value(s: GraphSample, name: str) -> np.ndarray:
+    if name in ("node_table", "graph_table"):
+        v = s.extras.get(name)
+        if v is None:
+            return np.zeros((0, 1), np.float32)
+        v = np.asarray(v)
+        return v.reshape(-1, v.shape[-1]) if v.ndim > 1 else v.reshape(-1, 1)
+    v = getattr(s, name)
+    v = np.asarray(v)
+    return v.reshape(-1, 1) if v.ndim == 1 else v
+
+
+class PackedWriter:
+    """Serialize a list of GraphSamples into one packed file."""
+
+    def __init__(self, samples, path: str, attrs: dict | None = None):
+        n = len(samples)
+        keys = []
+        blobs = []
+        for name, dtype in _FIELDS:
+            vals = [_field_value(s, name).astype(dtype) for s in samples]
+            # zero-width columns (e.g. absent edge_attr) are preserved as 0
+            widths = {v.shape[1] for v in vals}
+            if len(widths) > 1:
+                raise ValueError(
+                    f"key '{name}' has inconsistent column widths {sorted(widths)} "
+                    "across samples; packed files require a homogeneous schema"
+                )
+            cols = widths.pop() if widths else 1
+            counts = np.array([v.shape[0] for v in vals], np.int64)
+            data = (
+                np.concatenate(vals, axis=0)
+                if vals
+                else np.zeros((0, cols), dtype)
+            )
+            keys.append(
+                {"name": name, "dtype": np.dtype(dtype).str, "cols": int(cols)}
+            )
+            blobs.append((counts, np.ascontiguousarray(data)))
+
+        # extra per-sample scalars
+        dsid = np.array([s.dataset_id for s in samples], np.int32).reshape(-1, 1)
+        keys.append({"name": "dataset_id", "dtype": "<i4", "cols": 1})
+        blobs.append((np.ones(n, np.int64), dsid))
+
+        offset = 0
+        payload = []
+        for k, (counts, data) in zip(keys, blobs):
+            k["counts_offset"] = offset
+            offset += counts.nbytes
+            k["offset"] = offset
+            offset += data.nbytes
+            payload.append((counts, data))
+
+        header = json.dumps(
+            {"n_samples": n, "keys": keys, "attrs": attrs or {}}
+        ).encode()
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            f.write(np.int64(len(header)).tobytes())
+            for counts, data in payload:
+                f.write(counts.tobytes())
+                f.write(data.tobytes())
+            f.write(header)
+            f.write(np.int64(len(header)).tobytes())  # trailer for locating header
+
+
+class PackedDataset:
+    """Memory-mapped reads with per-process subset windows."""
+
+    def __init__(self, path: str, subset: range | None = None):
+        self.path = path
+        with open(path, "rb") as f:
+            magic = f.read(8)
+            if magic != MAGIC:
+                raise ValueError(f"{path}: not a packed dataset (magic {magic!r})")
+            f.seek(-8, os.SEEK_END)
+            header_len = int(np.frombuffer(f.read(8), np.int64)[0])
+            f.seek(-8 - header_len, os.SEEK_END)
+            self.meta = json.loads(f.read(header_len))
+        self._mm = np.memmap(path, dtype=np.uint8, mode="r")
+        self._base = 16  # magic + header_len prefix
+        self._keys = {k["name"]: k for k in self.meta["keys"]}
+        self._counts = {}
+        self._offsets = {}
+        n = self.meta["n_samples"]
+        for k in self.meta["keys"]:
+            c = np.frombuffer(
+                self._mm, np.int64, count=n, offset=self._base + k["counts_offset"]
+            )
+            self._counts[k["name"]] = c
+            self._offsets[k["name"]] = np.concatenate(
+                [[0], np.cumsum(c)]
+            )  # row offsets
+        self.subset = subset if subset is not None else range(n)
+
+    def __len__(self) -> int:
+        return len(self.subset)
+
+    @property
+    def attrs(self) -> dict:
+        return self.meta.get("attrs", {})
+
+    def _read(self, name: str, i: int) -> np.ndarray:
+        k = self._keys[name]
+        dtype = np.dtype(k["dtype"])
+        cols = k["cols"]
+        row0 = self._offsets[name][i]
+        rows = self._counts[name][i]
+        start = self._base + k["offset"] + row0 * cols * dtype.itemsize
+        out = np.frombuffer(
+            self._mm, dtype, count=rows * cols, offset=int(start)
+        ).reshape(rows, cols)
+        return out
+
+    def __getitem__(self, idx: int) -> GraphSample:
+        i = self.subset[idx]
+        get = self._read
+        s = GraphSample(
+            x=get("x", i),
+            pos=get("pos", i),
+            senders=get("senders", i)[:, 0],
+            receivers=get("receivers", i)[:, 0],
+            edge_attr=get("edge_attr", i),
+            edge_shifts=get("edge_shifts", i),
+            graph_y=get("graph_y", i)[:, 0],
+            node_y=get("node_y", i),
+            energy_y=get("energy_y", i)[:, 0],
+            forces_y=get("forces_y", i),
+            dataset_id=int(get("dataset_id", i)[0, 0]),
+        )
+        nt = get("node_table", i)
+        gt = get("graph_table", i)
+        if nt.size:
+            s.extras["node_table"] = nt
+        if gt.size:
+            s.extras["graph_table"] = gt[:, 0]
+        return s
+
+    def load_all(self) -> list[GraphSample]:
+        return [self[i] for i in range(len(self))]
+
+    def setsubset(self, start: int, stop: int) -> "PackedDataset":
+        """Per-rank shard window (AdiosDataset.setsubset semantics)."""
+        self.subset = range(start, stop)
+        return self

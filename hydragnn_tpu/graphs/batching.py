@@ -144,6 +144,7 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
     pe_dim = first.extras["pe"].shape[1] if "pe" in first.extras else 0
     pe = np.zeros((N, pe_dim), np.float32)
     rel_pe = np.zeros((E, pe_dim), np.float32)
+    z = np.zeros((N,), np.int32)
 
     node_off = 0
     edge_off = 0
@@ -171,6 +172,8 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
         graph_mask[g] = 1.0
         n_node[g] = n
         dataset_id[g] = s.dataset_id
+        zs = s.extras.get("atomic_numbers", s.x[:, 0] if s.x.shape[1] else np.zeros(n))
+        z[node_off : node_off + n] = np.round(np.asarray(zs).reshape(-1)).astype(np.int32)
         if pe_dim and "pe" in s.extras:
             pe[node_off : node_off + n] = s.extras["pe"]
             rel_pe[edge_off : edge_off + e] = s.extras["rel_pe"]
@@ -192,7 +195,7 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
         node_mask=node_mask, edge_mask=edge_mask, graph_mask=graph_mask,
         n_node=n_node, dataset_id=dataset_id,
         idx_kj=idx_kj, idx_ji=idx_ji, triplet_mask=triplet_mask,
-        pe=pe, rel_pe=rel_pe,
+        pe=pe, rel_pe=rel_pe, z=z,
     )
 
 

@@ -61,6 +61,9 @@ class GraphBatch(NamedTuple):
     - ``pe``:       [N, K]      Laplacian positional encodings (GPS; width 0
       unless the pipeline attaches them)
     - ``rel_pe``:   [E, K]      relative edge encodings |pe_i - pe_j|
+    - ``z``:        [N]         raw atomic numbers (int32) — preserved BEFORE
+      feature normalization so element-aware models (MACE one-hot Z) are not
+      corrupted by min-max scaling of x
     """
 
     x: Array
@@ -85,6 +88,7 @@ class GraphBatch(NamedTuple):
     triplet_mask: Array
     pe: Array
     rel_pe: Array
+    z: Array
 
     # -- static helpers -------------------------------------------------------
     @property

@@ -234,6 +234,34 @@ class HydraModel(nn.Module):
         self._head_cols = cols
         self._node_local_needed = node_local_needed
 
+        # graph-attribute conditioning (reference Base.py:249-444):
+        # 'film'        — gamma/beta modulation of node features per layer
+        # 'concat_node' — broadcast graph_attr to nodes, concat + project
+        # 'fuse_pool'   — fuse into the pooled embedding before graph heads
+        if spec.use_graph_attr_conditioning:
+            mode = spec.graph_attr_conditioning_mode
+            if mode not in ("film", "concat_node", "fuse_pool"):
+                raise ValueError(
+                    "graph_attr_conditioning_mode must be one of: "
+                    "'film', 'concat_node', 'fuse_pool'"
+                )
+            if mode == "film":
+                self.graph_conditioner = MLP(
+                    features=(spec.hidden_dim, 2 * spec.hidden_dim),
+                    activation=spec.activation,
+                    name="graph_conditioner",
+                )
+            elif mode == "concat_node":
+                self.graph_concat_projector = nn.Dense(
+                    spec.hidden_dim, name="graph_concat_projector"
+                )
+            else:  # fuse_pool
+                self.graph_pool_projector = MLP(
+                    features=(spec.hidden_dim, spec.hidden_dim),
+                    activation=spec.activation,
+                    name="graph_pool_projector",
+                )
+
     # -- encoder ------------------------------------------------------------
     def encode(self, batch: GraphBatch, train: bool = False):
         """Run the conv stack; returns (node_features, equiv_features)."""
@@ -249,6 +277,7 @@ class HydraModel(nn.Module):
         layer_outs = []
         for conv, norm in zip(self.graph_convs, self.feature_layers):
             inv, equiv = conv(inv, equiv, batch, train)  # positional: remat statics
+            inv = self._apply_graph_conditioning(inv, batch)
             if norm is not None:
                 inv = norm(inv, batch.node_mask, train)
             if stack_activation:
@@ -258,6 +287,26 @@ class HydraModel(nn.Module):
         if collect:
             inv = jnp.concatenate(layer_outs, axis=-1)
         return inv, equiv
+
+    def _apply_graph_conditioning(self, inv: Array, batch: GraphBatch) -> Array:
+        """Per-layer node-feature conditioning on graph attributes
+        (reference ``_apply_graph_conditioning``, Base.py:346-420)."""
+        spec = self.spec
+        if not spec.use_graph_attr_conditioning or batch.graph_attr.shape[1] == 0:
+            return inv
+        mode = spec.graph_attr_conditioning_mode
+        if mode == "film":
+            gb = self.graph_conditioner(batch.graph_attr)  # [G, 2H]
+            gamma, beta = jnp.split(gb, 2, axis=-1)
+            h = min(inv.shape[-1], gamma.shape[-1])
+            scaled = inv[:, :h] * (1.0 + gamma[batch.batch][:, :h]) + beta[
+                batch.batch
+            ][:, :h]
+            return jnp.concatenate([scaled, inv[:, h:]], axis=-1)
+        if mode == "concat_node":
+            ga = batch.graph_attr[batch.batch]  # broadcast to nodes
+            return self.graph_concat_projector(jnp.concatenate([inv, ga], axis=-1))
+        return inv  # fuse_pool conditions at the pooled level instead
 
     def embed(self, batch: GraphBatch):
         """Input embedding. With GPS, node features and Laplacian positional
@@ -278,12 +327,21 @@ class HydraModel(nn.Module):
         return batch.x, batch.pos
 
     def pool(self, x: Array, batch: GraphBatch) -> Array:
-        return segment.global_pool(
+        pooled = segment.global_pool(
             self.spec.graph_pooling,
             x * batch.node_mask[:, None],
             batch.batch,
             batch.num_graphs,
         )
+        if (
+            self.spec.use_graph_attr_conditioning
+            and self.spec.graph_attr_conditioning_mode == "fuse_pool"
+            and batch.graph_attr.shape[1] > 0
+        ):
+            pooled = self.graph_pool_projector(
+                jnp.concatenate([pooled, batch.graph_attr], axis=-1)
+            )
+        return pooled
 
     # -- full forward --------------------------------------------------------
     def __call__(self, batch: GraphBatch, train: bool = False):

@@ -23,8 +23,8 @@ TPU-native redesign (capability parity, not a weight-for-weight port):
   space as the reference's U-matrix contraction with a mildly overcomplete
   parameterization;
 * node attributes are one-hot atomic numbers over the full periodic table
-  (Z in 1..118, ``MACEStack :510-541``), read from the first input feature
-  column;
+  (Z in 1..118, ``MACEStack :510-541``), read from ``batch.z`` — the raw
+  pre-normalization atomic numbers;
 * per-layer readouts: the stack exposes every layer's scalars to the heads
   (``collect_layer_outputs``) instead of summing per-layer decoders.
 """
@@ -42,6 +42,9 @@ from ..graphs.graph import GraphBatch
 from ..graphs import segment
 from .base import register_conv
 from .harmonics import coupling_paths, spherical_harmonics, tensor_product
+from .radial import BesselBasis, ChebyshevBasis, GaussianSmearing, polynomial_cutoff
+
+NUM_ELEMENTS = 119  # Z in 0..118; index 0 absorbs non-integer/unknown types
 
 
 def _pack_equiv(feats: dict, l_max: int) -> jax.Array:
@@ -57,9 +60,6 @@ def _unpack_equiv(equiv: jax.Array, l_max: int) -> dict:
         feats[l] = equiv[:, off : off + 2 * l + 1, :]
         off += 2 * l + 1
     return feats
-from .radial import BesselBasis, ChebyshevBasis, GaussianSmearing, polynomial_cutoff
-
-NUM_ELEMENTS = 119  # Z in 0..118; index 0 absorbs non-integer/unknown types
 
 
 class IrrepsLinear(nn.Module):
@@ -128,7 +128,10 @@ class MACEConv(nn.Module):
         feats = IrrepsLinear(C, node_ell, bias=True, name="linear_up")(feats)
 
         # --- node attributes: one-hot Z + element embedding gate ---
-        z = jnp.clip(jnp.round(batch.x[:, 0]).astype(jnp.int32), 0, NUM_ELEMENTS - 1)
+        # batch.z carries RAW atomic numbers captured before feature
+        # normalization (min-max scaling of x would collapse all elements
+        # onto embedding rows 0/1)
+        z = jnp.clip(batch.z.astype(jnp.int32), 0, NUM_ELEMENTS - 1)
         elem_gate = nn.Embed(NUM_ELEMENTS, C, name="element_embed")(z)  # [N, C]
 
         # --- edge attributes ---

@@ -1,0 +1,92 @@
+"""Native (C++) runtime components, built on demand and loaded via ctypes.
+
+The reference's runtime leans on external C++ (ADIOS2, DDStore, GPTL —
+SURVEY §2.9); this package holds the TPU build's own native pieces. Build is
+lazy (first import compiles with the system g++ into the package directory)
+with a pure-numpy fallback so the framework works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libpacked_gather.so")
+_SRC = os.path.join(_HERE, "packed_gather.cpp")
+
+_lib = None
+_build_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC, "-lpthread"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """The loaded native library, or None (numpy fallback)."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not _build():
+            _build_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+        lib.gpk_gather.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        lib.gpk_gather_mt.argtypes = lib.gpk_gather.argtypes + [ctypes.c_int]
+        _lib = lib
+    except OSError:
+        _build_failed = True
+    return _lib
+
+
+def gather_blocks(
+    src: np.ndarray,
+    src_off: np.ndarray,
+    nbytes: np.ndarray,
+    dst_off: np.ndarray,
+    dst: np.ndarray,
+    threads: int = 0,
+) -> None:
+    """Copy variable-length byte blocks src->dst (native when available)."""
+    n = len(src_off)
+    lib = get_lib()
+    if lib is None:
+        sv = src.view(np.uint8)
+        dv = dst.view(np.uint8)
+        for i in range(n):
+            dv[dst_off[i] : dst_off[i] + nbytes[i]] = sv[
+                src_off[i] : src_off[i] + nbytes[i]
+            ]
+        return
+    so = np.ascontiguousarray(src_off, np.int64)
+    nb = np.ascontiguousarray(nbytes, np.int64)
+    do = np.ascontiguousarray(dst_off, np.int64)
+    src_p = src.ctypes.data_as(ctypes.c_char_p)
+    dst_p = dst.ctypes.data_as(ctypes.c_char_p)
+    i64p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    if threads > 1:
+        lib.gpk_gather_mt(src_p, i64p(so), i64p(nb), i64p(do), dst_p, n, threads)
+    else:
+        lib.gpk_gather(src_p, i64p(so), i64p(nb), i64p(do), dst_p, n)

@@ -1,0 +1,64 @@
+// Native data-plane core for the packed-record dataset format.
+//
+// The reference's data plane rides C++ throughout: ADIOS2 for parallel reads
+// and DDStore for in-RAM sample fetches (SURVEY §2.9). This library is the
+// TPU build's equivalent hot path: it performs the per-batch gather —
+// copying many samples' variable-length rows out of a memory-mapped packed
+// file (or host RAM) into preallocated padded host buffers — without holding
+// the GIL and with optional multithreading, so Python-side collation cost
+// does not bound input throughput.
+//
+// Build: g++ -O3 -shared -fPIC -o libpacked_gather.so packed_gather.cpp -lpthread
+// ABI: plain C, consumed via ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Copy n variable-length blocks: dst[dst_off[i] : dst_off[i]+nbytes[i]] =
+// src[src_off[i] : src_off[i]+nbytes[i]].
+void gpk_gather(const char* src, const int64_t* src_off, const int64_t* nbytes,
+                const int64_t* dst_off, char* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(dst + dst_off[i], src + src_off[i],
+                static_cast<size_t>(nbytes[i]));
+  }
+}
+
+// Threaded variant for large batches; splits blocks across `threads`.
+void gpk_gather_mt(const char* src, const int64_t* src_off,
+                   const int64_t* nbytes, const int64_t* dst_off, char* dst,
+                   int64_t n, int threads) {
+  if (threads <= 1 || n < 64) {
+    gpk_gather(src, src_off, nbytes, dst_off, dst, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back([=] {
+      for (int64_t i = lo; i < hi; ++i) {
+        std::memcpy(dst + dst_off[i], src + src_off[i],
+                    static_cast<size_t>(nbytes[i]));
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// int32 edge-index rebase: dst[i] = src[i] + base, with sentinel fill for the
+// padded tail (dst length >= n). Used when assembling padded edge arrays.
+void gpk_rebase_i32(const int32_t* src, int32_t* dst, int64_t n, int32_t base,
+                    int64_t dst_len, int32_t sentinel) {
+  int64_t i = 0;
+  for (; i < n; ++i) dst[i] = src[i] + base;
+  for (; i < dst_len; ++i) dst[i] = sentinel;
+}
+
+}  // extern "C"

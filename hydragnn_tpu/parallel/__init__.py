@@ -29,3 +29,10 @@ __all__ = [
     "put_batch",
     "batch_shardings",
 ]
+from .distributed import (  # noqa: E402
+    setup_ddp,
+    init_comm_size_and_rank,
+    get_comm_size_and_rank,
+)
+
+__all__ += ["setup_ddp", "init_comm_size_and_rank", "get_comm_size_and_rank"]

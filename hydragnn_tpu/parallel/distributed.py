@@ -1,0 +1,119 @@
+"""Multi-host bootstrap: rank/world discovery + jax.distributed init.
+
+Reference: ``hydragnn/utils/distributed/distributed.py:113-280`` — an env
+cascade (OpenMPI -> SLURM -> LSF/PBS -> single process) discovers rank/world,
+then a torch process group is built with a master address parsed from the
+scheduler's nodelist and a port derived from the job id with EADDRINUSE
+retries.
+
+TPU equivalent: the same cascade feeds ``jax.distributed.initialize`` —
+afterwards every host sees the global device set and ONE jitted SPMD program
+spans the pod; there are no NCCL/Gloo backends to pick because XLA owns the
+collectives. On Cloud TPU pods, ``initialize()`` needs no arguments at all
+(the runtime provides coordination); the cascade covers
+SLURM/MPI-style clusters.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+
+
+def init_comm_size_and_rank() -> tuple[int, int]:
+    """(world_size, rank) from the scheduler env cascade (reference :113-135)."""
+    if os.getenv("OMPI_COMM_WORLD_SIZE"):
+        return (
+            int(os.environ["OMPI_COMM_WORLD_SIZE"]),
+            int(os.environ["OMPI_COMM_WORLD_RANK"]),
+        )
+    if os.getenv("SLURM_NPROCS") and os.getenv("SLURM_PROCID") is not None:
+        return int(os.environ["SLURM_NPROCS"]), int(os.environ["SLURM_PROCID"])
+    if os.getenv("PMI_SIZE"):  # PBS/Intel MPI
+        return int(os.environ["PMI_SIZE"]), int(os.environ["PMI_RANK"])
+    if os.getenv("JAX_NUM_PROCESSES"):
+        return int(os.environ["JAX_NUM_PROCESSES"]), int(
+            os.environ.get("JAX_PROCESS_ID", 0)
+        )
+    return 1, 0
+
+
+def _first_host_from_nodelist() -> str | None:
+    """Master host from scheduler nodelists (reference :79-110, 191-215)."""
+    lsb = os.getenv("LSB_HOSTS")
+    if lsb:
+        hosts = [h for h in lsb.split() if h and h != "batch"]
+        if hosts:
+            return hosts[0]
+    slurm = os.getenv("SLURM_NODELIST") or os.getenv("SLURM_JOB_NODELIST")
+    if slurm:
+        try:
+            out = subprocess.run(
+                ["scontrol", "show", "hostnames", slurm],
+                capture_output=True,
+                text=True,
+                timeout=10,
+            ).stdout.split()
+            if out:
+                return out[0]
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        # fallback: expand "prefix[a-b,...]" manually
+        m = re.match(r"^([^\[]+)\[(\d+)", slurm)
+        if m:
+            return f"{m.group(1)}{m.group(2)}"
+        return slurm.split(",")[0]
+    pbs = os.getenv("PBS_NODEFILE")
+    if pbs and os.path.exists(pbs):
+        with open(pbs) as f:
+            first = f.readline().strip()
+            if first:
+                return first
+    return None
+
+
+def _port_from_job_id(default: int = 8889) -> int:
+    """Deterministic port derived from the job id (reference :171-185)."""
+    if os.getenv("HYDRAGNN_MASTER_PORT"):
+        return int(os.environ["HYDRAGNN_MASTER_PORT"])
+    job = os.getenv("SLURM_JOB_ID") or os.getenv("LSB_JOBID") or os.getenv("PBS_JOBID")
+    if job:
+        digits = re.sub(r"\D", "", job) or "0"
+        return 10000 + int(digits) % 50000
+    return default
+
+
+def setup_ddp(verbosity: int = 0) -> tuple[int, int]:
+    """Initialize multi-host jax (the ``setup_ddp`` entry point, reference
+    :151-280). Returns (world_size, rank). Safe to call in single-process
+    runs (no-op) and idempotent."""
+    import jax
+
+    world, rank = init_comm_size_and_rank()
+    if world <= 1:
+        return 1, 0
+    if jax.process_count() > 1:  # already initialized
+        return jax.process_count(), jax.process_index()
+
+    coordinator = os.getenv("HYDRAGNN_MASTER_ADDR") or _first_host_from_nodelist()
+    kwargs = {}
+    if coordinator:
+        kwargs["coordinator_address"] = f"{coordinator}:{_port_from_job_id()}"
+        kwargs["num_processes"] = world
+        kwargs["process_id"] = rank
+    # On Cloud TPU pods jax.distributed.initialize() self-configures.
+    jax.distributed.initialize(**kwargs)
+    return jax.process_count(), jax.process_index()
+
+
+def get_comm_size_and_rank() -> tuple[int, int]:
+    """Post-init world/rank (prefers live jax state over env)."""
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_count(), jax.process_index()
+    except Exception:
+        pass
+    return init_comm_size_and_rank()

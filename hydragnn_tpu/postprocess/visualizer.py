@@ -1,0 +1,87 @@
+"""Matplotlib visualization of training results (reference
+``hydragnn/postprocess/visualizer.py`` — parity scatter plots, error
+histograms, loss-history curves, written under ``./logs/<run>/``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class Visualizer:
+    def __init__(self, log_name: str, path: str = "./logs/", node_feature_names=None):
+        self.dir = os.path.join(path, log_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.node_feature_names = node_feature_names or []
+        self.history: dict[str, list[float]] = {}
+
+    def add_history(self, epoch: int, **scalars) -> None:
+        for k, v in scalars.items():
+            self.history.setdefault(k, []).append(float(v))
+
+    def plot_history(self, filename: str = "history.png") -> str:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(6, 4))
+        for k, vals in self.history.items():
+            ax.plot(vals, label=k)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("loss")
+        ax.set_yscale("log")
+        ax.legend()
+        out = os.path.join(self.dir, filename)
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out
+
+    def create_parity_plot(
+        self, true_values, predicted_values, names=None, filename: str = "parity.png"
+    ) -> str:
+        """Per-head parity scatter (reference ``create_scatter_plots``)."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        n = len(true_values)
+        fig, axes = plt.subplots(1, n, figsize=(4 * n, 4), squeeze=False)
+        for i, (t, p) in enumerate(zip(true_values, predicted_values)):
+            ax = axes[0][i]
+            t = np.asarray(t).ravel()
+            p = np.asarray(p).ravel()
+            ax.scatter(t, p, s=4, alpha=0.5)
+            lo, hi = min(t.min(), p.min()), max(t.max(), p.max())
+            ax.plot([lo, hi], [lo, hi], "k--", lw=1)
+            rmse = float(np.sqrt(np.mean((t - p) ** 2)))
+            title = names[i] if names and i < len(names) else f"head {i}"
+            ax.set_title(f"{title} (RMSE {rmse:.3g})")
+            ax.set_xlabel("true")
+            ax.set_ylabel("predicted")
+        out = os.path.join(self.dir, filename)
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out
+
+    def create_error_histogram(
+        self, true_values, predicted_values, filename: str = "error_hist.png"
+    ) -> str:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        n = len(true_values)
+        fig, axes = plt.subplots(1, n, figsize=(4 * n, 3), squeeze=False)
+        for i, (t, p) in enumerate(zip(true_values, predicted_values)):
+            err = (np.asarray(p) - np.asarray(t)).ravel()
+            axes[0][i].hist(err, bins=40)
+            axes[0][i].set_xlabel(f"head {i} error")
+        out = os.path.join(self.dir, filename)
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out

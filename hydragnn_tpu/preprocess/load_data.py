@@ -50,6 +50,9 @@ def apply_variables_of_interest(samples, config: dict) -> list[GraphSample]:
         graph_table = np.asarray(graph_table, np.float64).reshape(-1)
 
         s.x = node_table[:, input_cols].astype(np.float32)
+        # raw atomic numbers survive normalization (element-aware models)
+        if input_cols:
+            s.extras.setdefault("atomic_numbers", node_table[:, input_cols[0]].copy())
 
         graph_targets = []
         node_targets = []
@@ -191,6 +194,19 @@ def dataset_loading_and_splitting(config: dict, samples=None, rank: int = 0, wor
 
         samples = load_raw_dataset(config)
     training = config.setdefault("NeuralNetwork", {}).setdefault("Training", {})
+    # raw-format samples arrive without neighbor lists: build radius graphs
+    # from the architecture's cutoff (reference SerializedDataLoader
+    # ``load_serialized_data`` radius-graph pass, serialized_dataset_loader.py:134-150)
+    arch_pre = config["NeuralNetwork"].get("Architecture", {})
+    radius = arch_pre.get("radius")
+    if radius and any(s.num_edges == 0 and s.num_nodes > 1 for s in samples):
+        from ..graphs.radius import build_radius_graph
+
+        for s in samples:
+            if s.num_edges == 0 and s.num_nodes > 1:
+                build_radius_graph(
+                    s, float(radius), max_neighbours=arch_pre.get("max_neighbours")
+                )
     samples = apply_variables_of_interest(samples, config)
     arch_cfg = config["NeuralNetwork"].get("Architecture", {})
     if arch_cfg.get("mpnn_type") == "DimeNet":

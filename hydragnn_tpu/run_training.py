@@ -9,6 +9,7 @@ optional in-memory dataset (list of ``GraphSample``). Returns the final
 
 from __future__ import annotations
 
+import os
 from typing import Sequence
 
 from .config import ModelSpec, get_log_name_config, load_config, save_config, update_config
@@ -46,6 +47,32 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     example = next(iter(train_loader))
     state = create_train_state(model, optimizer, example)
 
+    # TensorBoard scalars on process 0 (reference get_summary_writer,
+    # model.py:193-199). tensorboardX is preferred (torch-free); the torch
+    # writer is the fallback since torch ships in most reference installs.
+    # HYDRAGNN_TENSORBOARD=0 disables.
+    writer = None
+    if os.getenv("HYDRAGNN_TENSORBOARD", "1") != "0":
+        try:
+            import jax
+
+            if jax.process_index() == 0:
+                try:
+                    from tensorboardX import SummaryWriter
+                except ImportError:
+                    from torch.utils.tensorboard import SummaryWriter
+
+                writer = SummaryWriter(os.path.join("./logs", log_name))
+        except Exception as e:
+            print_distributed(
+                verbosity, f"TensorBoard logging disabled ({type(e).__name__}: {e})"
+            )
+            writer = None
+
+    # walltime guard (reference distributed.py:614-639): stop before SLURM
+    # kills the job so the best checkpoint survives
+    from .utils.walltime import make_walltime_check
+
     state = train_validate_test(
         model,
         optimizer,
@@ -56,7 +83,26 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
         config["NeuralNetwork"],
         log_name,
         verbosity,
+        writer=writer,
+        walltime_check=make_walltime_check(),
     )
+    if writer is not None:
+        writer.close()
+
+    # end-of-run visualization (reference train_validate_test :441-491)
+    if config.get("Visualization", {}).get("create_plots"):
+        try:
+            from .postprocess.visualizer import Visualizer
+            from .run_prediction import run_prediction
+
+            _, _, trues, preds = run_prediction(config, state, model, samples=samples)
+            viz = Visualizer(log_name)
+            viz.create_parity_plot(
+                trues, preds, names=config["NeuralNetwork"]["Variables_of_interest"].get("output_names")
+            )
+            viz.create_error_histogram(trues, preds)
+        except Exception as e:  # plots must never kill a finished training
+            print_distributed(verbosity, f"visualization failed: {e}")
 
     tr.print_timers(verbosity)
     return state, model, config

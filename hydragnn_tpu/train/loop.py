@@ -145,6 +145,15 @@ def train_validate_test(
             print_distributed(
                 verbosity, f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}"
             )
+            if writer is not None:
+                writer.add_scalar("train error", train_loss, epoch)
+            # checkpoint on train loss and honor the walltime guard even
+            # without evaluation — a SLURM kill must not lose the run
+            if checkpoint is not None:
+                checkpoint(state, epoch, train_loss)
+            if walltime_check is not None and walltime_check():
+                print_distributed(verbosity, f"Walltime guard tripped at epoch {epoch}")
+                break
             continue
 
         val_loss, val_tasks, _ = evaluate(eval_step, state, val_loader, verbosity, "validate")

@@ -1,0 +1,108 @@
+"""Multibranch / multidataset foundation-model training.
+
+Reference: ``hydragnn/models/MultiTaskModelMP.py:269-490`` + the GFM driver
+``examples/multibranch/train.py`` (SURVEY §3.4): N datasets train one shared
+encoder with per-dataset decoder branches over a 2D ``(branch, data)``
+process grid; dataset sizes are equalized by oversampling
+(``load_data.py:239-249``).
+
+TPU redesign: branch routing lives INSIDE the jitted model (per-graph
+``dataset_id`` where-selects, ``HydraModel.__call__``), so the whole thing is
+one SPMD program over a ``(branch, data)`` mesh:
+
+* each mesh row (branch) feeds batches drawn from its own dataset;
+* encoder params are replicated everywhere — XLA's gradient all-reduce over
+  the full mesh IS the reference's WORLD-process-group encoder sync;
+* branch decoders are replicated too, but a branch's decoder only receives
+  nonzero gradients from rows carrying its ``dataset_id`` (where-select
+  routes cotangents), so the cross-mesh all-reduce implements the reference's
+  per-branch process-group reduction with zero extra machinery. Sharding
+  decoder params onto branch submeshes is a memory optimization left for the
+  pod-scale tuning pass.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graphs.batching import GraphLoader, PadSpec, compute_pad_spec
+from ..graphs.graph import GraphSample
+
+# The reference hardcodes a 14-dataset id registry
+# (``utils/datasets/abstractbasedataset.py:50-64``); ids here are positional
+# per multidataset run, with names recorded for bookkeeping.
+
+
+def concat_multidataset(datasets: dict[str, list] | list[list]) -> list[GraphSample]:
+    """Tag each source dataset's samples with a branch ``dataset_id`` and
+    concatenate (the ``dataset_name`` mechanism of AbstractBaseDataset)."""
+    if isinstance(datasets, dict):
+        items = list(datasets.items())
+    else:
+        items = [(f"dataset-{i}", d) for i, d in enumerate(datasets)]
+    out = []
+    for branch_id, (_name, samples) in enumerate(items):
+        for s in samples:
+            s.dataset_id = branch_id
+            out.append(s)
+    return out
+
+
+class OversamplingLoader(GraphLoader):
+    """Epoch indices drawn WITH replacement to a fixed per-epoch size —
+    equalizing branch step counts for task-parallel load balance (reference
+    ``RandomSampler(replacement=True, num_samples=...)``,
+    ``load_data.py:239-249``)."""
+
+    def __init__(self, samples, batch_size: int, num_samples: int, **kw):
+        super().__init__(samples, batch_size, shuffle=True, **kw)
+        self.num_samples = int(num_samples)
+
+    def _epoch_indices(self) -> np.ndarray:
+        rng = np.random.default_rng(self.seed + self.epoch)
+        # draw a multiple of world so every rank gets the same batch count
+        # (unequal counts deadlock the SPMD all-reduce)
+        total = self.num_samples
+        if self.world > 1:
+            total = int(np.ceil(total / self.world) * self.world)
+        idx = rng.choice(len(self.samples), size=total, replace=True)
+        if self.world > 1:
+            idx = idx[self.rank :: self.world]
+        return idx
+
+
+def make_branch_loaders(
+    datasets: dict[str, list] | list[list],
+    batch_size: int,
+    n_branch_rows: int | None = None,
+    seed: int = 0,
+) -> tuple[list[GraphLoader], PadSpec]:
+    """One oversampling loader per branch, all sharing a pad bucket, each
+    sized to the LARGEST branch so every branch takes the same number of
+    steps per epoch (the SC25 weak-scaling recipe's oversampling)."""
+    if isinstance(datasets, dict):
+        branches = list(datasets.values())
+    else:
+        branches = list(datasets)
+    samples_all = concat_multidataset(datasets)
+    pad = compute_pad_spec(samples_all, batch_size)
+    target = max(len(b) for b in branches)
+    loaders = [
+        OversamplingLoader(
+            b, batch_size, num_samples=target, pad=pad, seed=seed + 31 * i
+        )
+        for i, b in enumerate(branches)
+    ]
+    return loaders, pad
+
+
+def interleave_branch_batches(loaders: list[GraphLoader], epoch: int):
+    """Yield per-step lists of per-branch batches: step t gives
+    [branch0_batch_t, branch1_batch_t, ...] — the row layout for a
+    (branch, data) mesh's stacked batch."""
+    for ld in loaders:
+        ld.set_epoch(epoch)
+    iters = [iter(ld) for ld in loaders]
+    n_steps = min(len(ld) for ld in loaders)
+    for _ in range(n_steps):
+        yield [next(it) for it in iters]

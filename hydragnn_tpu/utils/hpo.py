@@ -1,0 +1,111 @@
+"""Hyperparameter optimization (reference scope: ``hydragnn/utils/hpo/
+deephyper.py`` and the Optuna/DeepHyper drivers in ``examples/qm9_hpo`` /
+``examples/multidataset_hpo``).
+
+DeepHyper/Optuna are cluster-side dependencies; the built-in engine here is a
+self-contained random search with the same shape (search space dict ->
+objective -> best config), so HPO works out of the box and plugs into Optuna
+when it is installed (``backend="optuna"``).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Any, Callable
+
+import numpy as np
+
+
+def sample_config(space: dict[str, Any], rng: np.random.Generator) -> dict:
+    """Draw one assignment from a search-space dict. Entries may be:
+    list -> categorical; ("int", lo, hi) / ("float", lo, hi) /
+    ("log_float", lo, hi) -> ranges."""
+    out = {}
+    for key, spec in space.items():
+        if isinstance(spec, list):
+            out[key] = spec[rng.integers(len(spec))]
+        elif isinstance(spec, tuple) and spec[0] == "int":
+            out[key] = int(rng.integers(spec[1], spec[2] + 1))
+        elif isinstance(spec, tuple) and spec[0] == "float":
+            out[key] = float(rng.uniform(spec[1], spec[2]))
+        elif isinstance(spec, tuple) and spec[0] == "log_float":
+            out[key] = float(np.exp(rng.uniform(np.log(spec[1]), np.log(spec[2]))))
+        else:
+            raise ValueError(f"bad search-space entry {key}: {spec}")
+    return out
+
+
+def _set_by_path(config: dict, dotted: str, value) -> None:
+    node = config
+    keys = dotted.split(".")
+    for k in keys[:-1]:
+        node = node[k]
+    node[keys[-1]] = value
+
+
+def run_hpo(
+    base_config: dict,
+    space: dict[str, Any],
+    objective: Callable[[dict], float],
+    n_trials: int = 10,
+    seed: int = 0,
+    backend: str = "random",
+    log_path: str | None = None,
+) -> tuple[dict, float, list]:
+    """Minimize ``objective(config)`` over ``space``. Space keys are dotted
+    config paths (e.g. ``"NeuralNetwork.Architecture.hidden_dim"``).
+    Returns (best_config, best_value, trial history)."""
+    history = []
+
+    def build(assignment: dict) -> dict:
+        cfg = copy.deepcopy(base_config)
+        for key, val in assignment.items():
+            _set_by_path(cfg, key, val)
+        return cfg
+
+    if backend == "optuna":
+        try:
+            import optuna
+        except ImportError:
+            backend = "random"
+    if backend == "optuna":
+        def opt_objective(trial):
+            assignment = {}
+            for key, spec in space.items():
+                if isinstance(spec, list):
+                    assignment[key] = trial.suggest_categorical(key, spec)
+                elif spec[0] == "int":
+                    assignment[key] = trial.suggest_int(key, spec[1], spec[2])
+                elif spec[0] == "float":
+                    assignment[key] = trial.suggest_float(key, spec[1], spec[2])
+                else:
+                    assignment[key] = trial.suggest_float(key, spec[1], spec[2], log=True)
+            value = objective(build(assignment))
+            history.append({"assignment": assignment, "value": value})
+            return value
+
+        study = optuna.create_study(direction="minimize")
+        study.optimize(opt_objective, n_trials=n_trials)
+        best_assignment = study.best_params
+        best_value = study.best_value
+    else:
+        rng = np.random.default_rng(seed)
+        best_assignment, best_value = None, float("inf")
+        for _ in range(n_trials):
+            assignment = sample_config(space, rng)
+            value = float(objective(build(assignment)))
+            history.append({"assignment": assignment, "value": value})
+            if value < best_value:
+                best_assignment, best_value = assignment, value
+
+    if log_path:
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        with open(log_path, "w") as f:
+            json.dump(
+                {"best": best_assignment, "value": best_value, "trials": history},
+                f,
+                indent=2,
+            )
+    return build(best_assignment), best_value, history

@@ -1,0 +1,58 @@
+"""Smoke-run the example drivers (reference ``tests/test_examples.py`` runs
+``examples/qm9``, ``examples/md17``, ``examples/LennardJones`` through
+subprocess)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    XLA_FLAGS=os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+)
+
+
+def run_example(args, timeout=420):
+    proc = subprocess.run(
+        [sys.executable] + args,
+        cwd=REPO,
+        env=ENV,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, f"example failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    return proc.stdout
+
+
+def test_example_qm9():
+    out = run_example(
+        ["examples/qm9/qm9.py", "--epochs", "2", "--samples", "60"]
+    )
+    assert "RMSE" in out
+
+
+def test_example_lennard_jones():
+    out = run_example(
+        ["examples/LennardJones/LennardJones.py", "--epochs", "3", "--configs", "30"]
+    )
+    assert "force RMSE" in out
+
+
+def test_example_md17():
+    out = run_example(
+        ["examples/md17/md17.py", "--epochs", "2", "--frames", "40", "--arch", "PAINN"]
+    )
+    assert "energy RMSE" in out
+
+
+def test_example_multibranch():
+    out = run_example(
+        ["examples/multibranch/train.py", "--epochs", "2", "--configs", "16"]
+    )
+    assert "mesh: (2 branch x 4 data)" in out
+    assert "epoch 1" in out

@@ -223,3 +223,27 @@ def test_conv_checkpointing_with_dropout_arch():
         rngs={"dropout": jax.random.PRNGKey(1)},
     )
     assert np.all(np.isfinite(np.asarray(out[0])))
+
+
+@pytest.mark.parametrize("mode", ["film", "concat_node", "fuse_pool"])
+def test_graph_attr_conditioning(mode):
+    """Graph-attribute conditioning (reference test_graphs_graphattr.py
+    scope): outputs must depend on graph_attr in every mode."""
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["use_graph_attr_conditioning"] = True
+    cfg["NeuralNetwork"]["Architecture"]["graph_attr_conditioning_mode"] = mode
+    samples = deterministic_graph_data(number_configurations=6, seed=7)
+    samples = apply_variables_of_interest(samples, cfg)
+    for i, s in enumerate(samples):
+        s.graph_attr = np.array([0.5 + i, 1.0], np.float32)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 4)
+    batch = jax.tree.map(jnp.asarray, collate(samples[:4], pad))
+    variables = init_model(model, batch)
+    out0 = model.apply(variables, batch, train=False)
+    out1 = model.apply(
+        variables, batch.replace(graph_attr=batch.graph_attr + 1.0), train=False
+    )
+    diff = float(jnp.abs(out0[0] - out1[0]).max())
+    assert diff > 1e-6, f"{mode}: outputs insensitive to graph_attr"

@@ -1,0 +1,79 @@
+"""Observability: tracer spans, TensorBoard, visualizer, walltime, HPO."""
+
+import os
+
+import numpy as np
+
+from hydragnn_tpu.postprocess.visualizer import Visualizer
+from hydragnn_tpu.utils import tracer as tr
+from hydragnn_tpu.utils.hpo import run_hpo, sample_config
+from hydragnn_tpu.utils.walltime import _parse_slurm_time, make_walltime_check
+
+
+def test_tracer_spans_and_save(tmp_path):
+    tr.reset()
+    with tr.span("train"):
+        with tr.span("forward"):
+            pass
+    tr.start("opt_step"); tr.stop("opt_step")
+    s = tr.summary()
+    assert set(s) == {"train", "forward", "opt_step"}
+    assert s["train"]["count"] == 1
+    tr.save(str(tmp_path), prefix="timing")
+    assert any(f.startswith("timing.p") for f in os.listdir(tmp_path))
+    tr.reset()
+
+
+def test_visualizer_writes_plots(tmp_path):
+    rng = np.random.default_rng(0)
+    t = [rng.normal(size=(50, 1))]
+    p = [t[0] + 0.1 * rng.normal(size=(50, 1))]
+    viz = Visualizer("vizrun", path=str(tmp_path))
+    viz.add_history(0, train=1.0, val=1.1)
+    viz.add_history(1, train=0.5, val=0.6)
+    assert os.path.exists(viz.plot_history())
+    assert os.path.exists(viz.create_parity_plot(t, p, names=["energy"]))
+    assert os.path.exists(viz.create_error_histogram(t, p))
+
+
+def test_walltime_parsing_and_check():
+    assert _parse_slurm_time("1-02:03:04") == ((26 * 60) + 3) * 60 + 4
+    assert _parse_slurm_time("15:30") == 930
+    check = make_walltime_check()
+    assert check() is False  # not under SLURM here
+
+
+def test_hpo_random_search_finds_minimum():
+    base = {"a": {"x": 0.0}, "b": 1}
+    space = {"a.x": ("float", -2.0, 2.0), "b": [1, 2, 3]}
+    rng_seen = []
+
+    def objective(cfg):
+        rng_seen.append(cfg)
+        return (cfg["a"]["x"] - 1.0) ** 2 + cfg["b"]
+
+    best_cfg, best_val, hist = run_hpo(base, space, objective, n_trials=40, seed=1)
+    assert len(hist) == 40
+    assert best_val < 1.3  # b=1 and x near 1
+    assert best_cfg["b"] == 1
+
+
+def test_hpo_over_training(tmp_path):
+    """HPO drives real (tiny) trainings end-to-end."""
+    import copy
+    import hydragnn_tpu
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from test_config import CI_CONFIG
+
+    samples = deterministic_graph_data(number_configurations=30, seed=51)
+    base = copy.deepcopy(CI_CONFIG)
+    base["NeuralNetwork"]["Training"]["num_epoch"] = 2
+
+    def objective(cfg):
+        state, model, aug = hydragnn_tpu.run_training(cfg, samples=list(samples))
+        err, *_ = hydragnn_tpu.run_prediction(cfg, state, model, samples=list(samples))
+        return err
+
+    space = {"NeuralNetwork.Architecture.hidden_dim": [4, 8]}
+    best_cfg, best_val, hist = run_hpo(base, space, objective, n_trials=2, seed=0)
+    assert np.isfinite(best_val) and len(hist) == 2

@@ -159,3 +159,29 @@ def test_parallel_mlip_step_dispatch():
     # MLIP metrics carry 3 task losses: energy, energy/atom, force
     assert metrics["tasks_loss"].shape == (3,)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_rank_discovery_env_cascade(monkeypatch):
+    from hydragnn_tpu.parallel import init_comm_size_and_rank
+
+    for var in ("OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK", "SLURM_NPROCS",
+                "SLURM_PROCID", "PMI_SIZE", "PMI_RANK", "JAX_NUM_PROCESSES"):
+        monkeypatch.delenv(var, raising=False)
+    assert init_comm_size_and_rank() == (1, 0)
+    monkeypatch.setenv("SLURM_NPROCS", "16")
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    assert init_comm_size_and_rank() == (16, 3)
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")  # MPI outranks SLURM
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "5")
+    assert init_comm_size_and_rank() == (8, 5)
+
+
+def test_master_port_derivation(monkeypatch):
+    from hydragnn_tpu.parallel.distributed import _port_from_job_id
+
+    monkeypatch.delenv("HYDRAGNN_MASTER_PORT", raising=False)
+    monkeypatch.setenv("SLURM_JOB_ID", "123456")
+    p = _port_from_job_id()
+    assert 10000 <= p < 60000
+    monkeypatch.setenv("HYDRAGNN_MASTER_PORT", "7777")
+    assert _port_from_job_id() == 7777
