@@ -47,6 +47,31 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     example = next(iter(train_loader))
     state = create_train_state(model, optimizer, example)
 
+    # auto-scale to every local device: one SPMD program over a 1D data mesh
+    # (HYDRAGNN_AUTO_PARALLEL=0 forces single-device; HYDRAGNN_USE_FSDP=1
+    # shards params/optimizer state — the reference's FSDP/ZeRO env knobs)
+    mesh = None
+    try:
+        import jax
+
+        n_dev = len(jax.devices())
+        if (
+            os.getenv("HYDRAGNN_AUTO_PARALLEL", "1") != "0"
+            and n_dev > 1
+            and len(train_loader) >= n_dev
+        ):
+            from .parallel import make_mesh, shard_state
+
+            mesh = make_mesh()
+            param_mode = "fsdp" if os.getenv("HYDRAGNN_USE_FSDP") == "1" else "replicated"
+            state = shard_state(state, mesh, param_mode=param_mode)
+            print_distributed(verbosity, f"auto-parallel: {n_dev}-device data mesh ({param_mode})")
+    except Exception as e:
+        if os.getenv("HYDRAGNN_USE_FSDP") == "1":
+            raise  # explicit sharding request: fail fast, don't downgrade
+        print_distributed(verbosity, f"auto-parallel disabled ({e})")
+        mesh = None
+
     # TensorBoard scalars on process 0 (reference get_summary_writer,
     # model.py:193-199). tensorboardX is preferred (torch-free); the torch
     # writer is the fallback since torch ships in most reference installs.
@@ -85,6 +110,7 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
         verbosity,
         writer=writer,
         walltime_check=make_walltime_check(),
+        mesh=mesh,
     )
     if writer is not None:
         writer.close()

@@ -37,17 +37,61 @@ def _max_num_batches(loader) -> int:
     return n
 
 
-def train_epoch(train_step, state: TrainState, loader, verbosity: int = 0):
+def _empty_like(batch):
+    """Same bucket, zero masks/targets: contributes nothing to any
+    graph-count-weighted metric (used to fill partial device groups)."""
+    import numpy as _np
+
+    zeroed = {"node_mask", "edge_mask", "graph_mask", "triplet_mask", "n_node",
+              "graph_y", "node_y", "energy_y", "forces_y"}
+    return type(batch)(
+        *[
+            _np.zeros_like(_np.asarray(v)) if f in zeroed else _np.asarray(v)
+            for f, v in zip(batch._fields, batch)
+        ]
+    )
+
+
+def _grouped(loader, n: int, mesh, fill: bool = False):
+    """Group n consecutive batches into one stacked [n, ...] device batch.
+    ``fill=True`` pads the trailing partial group with empty (masked-out)
+    batches — required for evaluation, where dropping batches would bias the
+    split metrics; training drops the partial group instead."""
+    from ..parallel.step import put_batch, stack_device_batches
+
+    group = []
+    for b in loader:
+        group.append(b)
+        if len(group) == n:
+            yield put_batch(stack_device_batches(group), mesh)
+            group = []
+    if group and fill:
+        group.extend([_empty_like(group[0])] * (n - len(group)))
+        yield put_batch(stack_device_batches(group), mesh)
+
+
+def train_epoch(train_step, state: TrainState, loader, verbosity: int = 0, mesh=None):
     """One training epoch; returns (state, mean loss, per-task mean losses)."""
     tot = 0.0
     tasks = None
     n_graphs = 0.0
     nbatch = _max_num_batches(loader)
+    n_dev = mesh.devices.size if mesh is not None else 1
+    if mesh is not None:
+        # the HYDRAGNN_MAX_NUM_BATCH cap counts raw loader batches; each
+        # grouped step consumes n_dev of them
+        nbatch = max(1, -(-nbatch // n_dev))
+    it = (
+        _grouped(loader, n_dev, mesh)
+        if mesh is not None
+        else iterate_tqdm(loader, verbosity, desc="train", total=nbatch)
+    )
     tr.start("train")
-    for ib, batch in enumerate(iterate_tqdm(loader, verbosity, desc="train", total=nbatch)):
+    for ib, batch in enumerate(it):
         if ib >= nbatch:
             break
-        batch = jax.tree.map(jnp.asarray, batch)
+        if mesh is None:
+            batch = jax.tree.map(jnp.asarray, batch)
         state, metrics = train_step(state, batch)
         # loss accumulated weighted by real graph count (reference :795-799)
         g = float(metrics["num_graphs"])
@@ -60,16 +104,26 @@ def train_epoch(train_step, state: TrainState, loader, verbosity: int = 0):
     return state, tot / denom, (tasks / denom if tasks is not None else np.zeros(0))
 
 
-def evaluate(eval_step, state: TrainState, loader, verbosity: int = 0, span: str = "validate"):
+def evaluate(
+    eval_step, state: TrainState, loader, verbosity: int = 0, span: str = "validate",
+    mesh=None,
+):
     """Full-split evaluation; returns (loss, per-task losses, per-head rmse)."""
     tot = 0.0
     tasks = None
     sse = None
     count = None
     n_graphs = 0.0
+    n_dev = mesh.devices.size if mesh is not None else 1
+    it = (
+        _grouped(loader, n_dev, mesh, fill=True)
+        if mesh is not None
+        else iterate_tqdm(loader, verbosity, desc=span, total=len(loader))
+    )
     tr.start(span)
-    for batch in iterate_tqdm(loader, verbosity, desc=span, total=len(loader)):
-        batch = jax.tree.map(jnp.asarray, batch)
+    for batch in it:
+        if mesh is None:
+            batch = jax.tree.map(jnp.asarray, batch)
         metrics = eval_step(state, batch)
         g = float(metrics["num_graphs"])
         tot += float(metrics["loss"]) * g
@@ -104,13 +158,61 @@ def train_validate_test(
     verbosity: int = 0,
     writer=None,
     walltime_check=None,
+    mesh=None,
 ) -> TrainState:
-    """The epoch loop. ``config_nn`` is the ``NeuralNetwork`` config section."""
+    """The epoch loop. ``config_nn`` is the ``NeuralNetwork`` config section.
+
+    With ``mesh`` set, steps run as SPMD programs over it (the state must
+    already be placed with ``shard_state``); the loaders are consumed in
+    device-count groups per step.
+    """
     training = config_nn["Training"]
     num_epoch = int(training["num_epoch"])
     precision = resolve_precision(training.get("precision", "fp32"))
 
-    if model.spec.enable_interatomic_potential:
+    if mesh is not None:
+        from ..parallel.step import make_parallel_eval_step, make_parallel_train_step
+
+        train_step = make_parallel_train_step(
+            model, optimizer, mesh, compute_dtype=precision
+        )
+        if model.spec.enable_interatomic_potential:
+            # MLIP eval runs per device shard, merged with graph-count
+            # weighting (matching the non-MLIP parallel eval's bookkeeping)
+            from ..models.mlip import make_mlip_eval_step
+
+            eval_step_single = make_mlip_eval_step(model, compute_dtype=precision)
+
+            def eval_step(state, batches):
+                import jax as _jax
+
+                sse = cnt = tasks = None
+                tot = 0.0
+                ng_sum = 0.0
+                n = _jax.tree.leaves(batches)[0].shape[0]
+                for d in range(n):
+                    b = _jax.tree.map(lambda x: x[d], batches)
+                    m = eval_step_single(state, b)
+                    ng = m["num_graphs"]
+                    tot = tot + m["loss"] * ng
+                    t = m["tasks_loss"] * ng
+                    tasks = t if tasks is None else tasks + t
+                    sse = m["head_sse"] if sse is None else sse + m["head_sse"]
+                    cnt = m["head_count"] if cnt is None else cnt + m["head_count"]
+                    ng_sum = ng_sum + ng
+                denom = jnp.maximum(ng_sum, 1.0)
+                return {
+                    "loss": tot / denom,
+                    "tasks_loss": tasks / denom,
+                    "head_sse": sse,
+                    "head_count": cnt,
+                    "num_graphs": ng_sum,
+                }
+
+        else:
+            eval_step = make_parallel_eval_step(model, mesh, compute_dtype=precision)
+
+    elif model.spec.enable_interatomic_potential:
         # MLIP path: energy + per-atom energy + jax.grad forces in the loss
         from ..models.mlip import make_mlip_eval_step, make_mlip_train_step
 
@@ -139,7 +241,9 @@ def train_validate_test(
 
     for epoch in range(num_epoch):
         train_loader.set_epoch(epoch)
-        state, train_loss, train_tasks = train_epoch(train_step, state, train_loader, verbosity)
+        state, train_loss, train_tasks = train_epoch(
+            train_step, state, train_loader, verbosity, mesh=mesh
+        )
 
         if skip_valtest:
             print_distributed(
@@ -156,9 +260,11 @@ def train_validate_test(
                 break
             continue
 
-        val_loss, val_tasks, _ = evaluate(eval_step, state, val_loader, verbosity, "validate")
+        val_loss, val_tasks, _ = evaluate(
+            eval_step, state, val_loader, verbosity, "validate", mesh=mesh
+        )
         test_loss, test_tasks, test_rmse = evaluate(
-            eval_step, state, test_loader, verbosity, "test"
+            eval_step, state, test_loader, verbosity, "test", mesh=mesh
         )
 
         new_lr = scheduler.step(val_loss)

@@ -221,3 +221,29 @@ def test_edge_sharded_giant_graph_matches_single_device():
     got = sharded_segment_sum(mesh, msgs_s, rcv_s, N)
     ref = jax.ops.segment_sum(msgs, rcv, num_segments=N)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=1e-5)
+
+
+def test_run_training_auto_parallel(monkeypatch):
+    """run_training auto-scales to all local devices when enabled: same API,
+    8-device SPMD steps, convergence with epoch budget scaled for the 8x
+    larger global batch."""
+    import copy
+
+    import hydragnn_tpu
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from test_config import CI_CONFIG
+
+    monkeypatch.setenv("HYDRAGNN_AUTO_PARALLEL", "1")
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 60
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = 8
+    samples = deterministic_graph_data(number_configurations=400, seed=61)
+    state, model, aug = hydragnn_tpu.run_training(cfg, samples=samples)
+    # params came back sharded over the mesh
+    leaf = jax.tree.leaves(state.params)[0]
+    assert len(leaf.sharding.device_set) == 8
+    err, tasks, trues, preds = hydragnn_tpu.run_prediction(
+        cfg, state, model, samples=samples
+    )
+    rmse = float(np.sqrt(np.mean((trues[0] - preds[0]) ** 2)))
+    assert rmse < 0.35, f"auto-parallel training failed to converge: {rmse:.3f}"
