@@ -131,6 +131,11 @@ def radius_graph(
         senders, receivers, shifts = _prune_max_neighbours(
             pos, senders, receivers, shifts, max_neighbours
         )
+    # Receiver-sorted edge order: segment reductions see contiguous runs per
+    # node, which keeps the Pallas fused-scatter kernel's per-block node
+    # windows narrow (ops/fused_scatter.py). Semantics are order-invariant.
+    order = np.lexsort((senders, receivers))
+    senders, receivers, shifts = senders[order], receivers[order], shifts[order]
     return senders.astype(np.int32), receivers.astype(np.int32), shifts.astype(np.float32)
 
 

@@ -32,7 +32,16 @@ def _zero_empty(out: Array, identity: Array) -> Array:
 
 
 def segment_sum(data: Array, segment_ids: Array, num_segments: int) -> Array:
-    """Sum ``data`` rows into ``num_segments`` buckets by ``segment_ids``."""
+    """Sum ``data`` rows into ``num_segments`` buckets by ``segment_ids``.
+
+    2D float data routes through the Pallas windowed scatter-add kernel
+    (``hydragnn_tpu.ops.fused_scatter``) when enabled — collated batches keep
+    segment ids near-sorted, so each edge block touches a narrow node window.
+    A/B switch: ``HYDRAGNN_FUSED_SCATTER=0|1`` (default: on for TPU)."""
+    from ..ops import fused_scatter
+
+    if data.ndim == 2 and fused_scatter._auto_enabled():
+        return fused_scatter.fused_segment_sum(data, segment_ids, num_segments)
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
 
 

@@ -31,8 +31,14 @@ class GINConv(nn.Module):
     ):
         hidden = self.out_dim or self.spec.hidden_dim
         eps = self.param("eps", nn.initializers.zeros, ())
-        messages = inv[batch.senders] * batch.edge_mask[:, None]
-        agg = segment.segment_sum(messages, batch.receivers, batch.num_nodes)
+        # fully-fused gather→mask→scatter (ops.fused_scatter); falls back to
+        # take + segment_sum when the kernel is disabled or shapes don't fit
+        from ..ops import gather_scatter_sum
+
+        agg = gather_scatter_sum(
+            inv, batch.senders, batch.receivers, batch.num_nodes,
+            weight=batch.edge_mask.astype(inv.dtype),
+        )
         out = MLP(
             features=(hidden, hidden),
             activation=self.spec.activation,

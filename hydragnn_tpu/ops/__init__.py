@@ -1,0 +1,5 @@
+"""TPU Pallas kernels for the message-passing hot path."""
+
+from .fused_scatter import fused_gather_scatter, gather_scatter_sum
+
+__all__ = ["fused_gather_scatter", "gather_scatter_sum"]
