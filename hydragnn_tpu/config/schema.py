@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 from copy import deepcopy
 from typing import Any, Sequence
@@ -127,6 +128,15 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     arch.setdefault("global_attn_type", None)
     arch.setdefault("global_attn_heads", 0)
     arch.setdefault("pe_dim", 0)
+    # Static per-graph width for dense-block attention (the reference's
+    # to_dense_batch N_max, globalAtt/gps.py:126-133, made compile-time):
+    # 8-aligned; graphs bigger than this fall back in-program to flat masked
+    # attention inside GPSConv.
+    if arch.get("global_attn_engine") and not arch.get("max_graph_nodes"):
+        max_n = max((s.num_nodes for s in train_samples), default=0)
+        arch["max_graph_nodes"] = int(math.ceil(max(max_n, 1) / 8) * 8)
+    else:
+        arch.setdefault("max_graph_nodes", None)
 
     # --- head normalization (reference :50-53) ---
     arch["output_heads"] = update_multibranch_heads(arch.get("output_heads", {}))
@@ -306,6 +316,7 @@ class ModelSpec:
     global_attn_engine: str | None = None
     global_attn_type: str | None = None
     global_attn_heads: int = 0
+    max_graph_nodes: int | None = None
     pe_dim: int = 0
     # conditioning / misc
     use_graph_attr_conditioning: bool = False
@@ -402,6 +413,7 @@ class ModelSpec:
             global_attn_engine=arch.get("global_attn_engine") or None,
             global_attn_type=arch.get("global_attn_type") or None,
             global_attn_heads=int(arch.get("global_attn_heads") or 0),
+            max_graph_nodes=arch.get("max_graph_nodes") or None,
             pe_dim=int(arch.get("pe_dim") or 0),
             use_graph_attr_conditioning=bool(arch.get("use_graph_attr_conditioning", False)),
             graph_attr_conditioning_mode=arch.get("graph_attr_conditioning_mode", "concat_node"),

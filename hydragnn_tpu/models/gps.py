@@ -2,13 +2,18 @@
 every conv layer becomes  local MPNN + per-graph multi-head self-attention,
 each with residual + norm, combined and passed through an MLP block.
 
-TPU redesign: the reference densifies each batch with ``to_dense_batch`` and
-runs ``nn.MultiheadAttention`` over [G, N_max, C] padded blocks — a
-ragged->dense conversion per step. Here attention runs directly on the flat
-padded node array with a same-graph mask (``batch[i] == batch[j]``): one
-[H, N, N] masked softmax, no data movement, static shapes. O(N^2) over the
-whole padded batch — within a graph it matches the reference's per-graph
-O(n^2); a Pallas block-sparse kernel is the scale-up path for giant graphs.
+TPU redesign of the reference's ``to_dense_batch`` + ``nn.MultiheadAttention``
+/ ``PerformerAttention`` pair (``gps.py:55-67,126-133``):
+
+* ``multihead``: nodes scatter into static dense blocks ``[G, N_max, C]``
+  (``N_max`` = ``spec.max_graph_nodes``, derived from the dataset at config
+  time), attention runs per graph — O(Σ nᵢ²) like the reference, not O((ΣN)²)
+  over the padded batch. Graphs that outgrow ``N_max`` at inference flip the
+  whole batch, in-program, to an exact flat masked-attention fallback.
+* ``performer``: FAVOR+ linear attention computed directly on the flat node
+  array — the per-graph softmax-kernel statistics are two ``segment_sum``s,
+  so cost is O(N · m · d) with zero densification. This is the option for
+  graphs where even per-graph dense attention is too big.
 """
 
 from __future__ import annotations
@@ -21,15 +26,59 @@ import dataclasses
 
 from ..config.schema import EDGE_MODELS, ModelSpec
 from ..graphs.graph import GraphBatch
+from ..graphs import segment
 from .base import CONV_REGISTRY
 from .common import MaskedBatchNorm, get_activation
 
 
+def _positions_in_graph(batch: GraphBatch, n_max: int):
+    """Per-node (graph_id, slot) coordinates for dense-block scatter/gather.
+    Real nodes of a graph are contiguous, so slot = node_id − graph_start."""
+    starts = jnp.cumsum(batch.n_node) - batch.n_node  # [G]
+    slot = jnp.arange(batch.num_nodes) - starts[batch.batch]
+    return jnp.clip(slot, 0, n_max - 1)
+
+
 class GraphMultiheadAttention(nn.Module):
-    """Self-attention restricted to nodes of the same graph."""
+    """Self-attention restricted to nodes of the same graph.
+
+    ``n_max > 0`` enables the dense-block path; otherwise (or when a graph
+    exceeds ``n_max`` at runtime) the exact flat masked path runs.
+    """
 
     channels: int
     heads: int
+    n_max: int = 0
+
+    def _flat_attention(self, q, k, v, batch: GraphBatch):
+        Dh = q.shape[-1]
+        logits = jnp.einsum("nhd,mhd->hnm", q, k) / jnp.sqrt(float(Dh))
+        same_graph = batch.batch[:, None] == batch.batch[None, :]
+        valid = same_graph & (batch.node_mask[None, :] > 0)
+        logits = jnp.where(valid[None, :, :], logits, -1e9)
+        attn = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("hnm,mhd->nhd", attn, v)
+
+    def _dense_attention(self, q, k, v, batch: GraphBatch):
+        """Scatter to [G, n_max, H, Dh] blocks, per-graph softmax attention,
+        gather back. Padded/overflow slots carry zero and are masked."""
+        G = batch.num_graphs
+        n_max = self.n_max
+        Dh = q.shape[-1]
+        slot = _positions_in_graph(batch, n_max)
+        gid = batch.batch
+
+        def to_dense(x):
+            buf = jnp.zeros((G, n_max) + x.shape[1:], x.dtype)
+            return buf.at[gid, slot].set(x * batch.node_mask[:, None, None])
+
+        qd, kd, vd = to_dense(q), to_dense(k), to_dense(v)
+        valid = jnp.arange(n_max)[None, :] < batch.n_node[:, None]  # [G, n_max]
+        logits = jnp.einsum("gnhd,gmhd->ghnm", qd, kd) / jnp.sqrt(float(Dh))
+        logits = jnp.where(valid[:, None, None, :], logits, -1e9)
+        attn = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("ghnm,gmhd->gnhd", attn, vd)
+        return out[gid, slot] * batch.node_mask[:, None, None]
 
     @nn.compact
     def __call__(self, h: jax.Array, batch: GraphBatch, train: bool = False):
@@ -40,13 +89,81 @@ class GraphMultiheadAttention(nn.Module):
         q = nn.Dense(self.channels, name="q")(h).reshape(N, H, Dh)
         k = nn.Dense(self.channels, name="k")(h).reshape(N, H, Dh)
         v = nn.Dense(self.channels, name="v")(h).reshape(N, H, Dh)
-        logits = jnp.einsum("nhd,mhd->hnm", q, k) / jnp.sqrt(float(Dh))
-        same_graph = batch.batch[:, None] == batch.batch[None, :]
-        valid = same_graph & (batch.node_mask[None, :] > 0)
-        logits = jnp.where(valid[None, :, :], logits, -1e9)
-        attn = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("hnm,mhd->nhd", attn, v).reshape(N, self.channels)
-        return nn.Dense(self.channels, name="out")(out)
+        if self.n_max and self.n_max < N:
+            fits = jnp.all(batch.n_node <= self.n_max)
+            out = jax.lax.cond(
+                fits,
+                lambda: self._dense_attention(q, k, v, batch),
+                lambda: self._flat_attention(q, k, v, batch),
+            )
+        else:
+            out = self._flat_attention(q, k, v, batch)
+        return nn.Dense(self.channels, name="out")(out.reshape(N, self.channels))
+
+
+class PerformerAttention(nn.Module):
+    """FAVOR+ softmax-kernel linear attention per graph (the reference's
+    ``PerformerAttention`` option, ``gps.py:62-67``), on flat node arrays:
+
+        out_i = φ(q_i) · Σ_{j∈g(i)} φ(k_j) v_jᵀ  /  φ(q_i) · Σ_{j∈g(i)} φ(k_j)
+
+    with φ the positive random-feature map exp(w·x − ‖x‖²/2). The per-graph
+    sums are ``segment_sum``s over nodes — O(N·m·d), no densification.
+    """
+
+    channels: int
+    heads: int
+    num_features: int = 0  # default: Dh rounded up to 8
+
+    @nn.compact
+    def __call__(self, h: jax.Array, batch: GraphBatch, train: bool = False):
+        N = h.shape[0]
+        H = self.heads
+        Dh = self.channels // H
+        m = self.num_features or max(8, (Dh + 7) // 8 * 8)
+        q = nn.Dense(self.channels, name="q")(h).reshape(N, H, Dh)
+        k = nn.Dense(self.channels, name="k")(h).reshape(N, H, Dh)
+        v = nn.Dense(self.channels, name="v")(h).reshape(N, H, Dh)
+
+        # Fixed (non-trainable) projection, seeded per layer from the module
+        # path: independent draws across depth keep the per-layer FAVOR+
+        # estimates unbiased instead of compounding one shared error.
+        import zlib
+
+        seed = zlib.crc32("/".join(self.path).encode()) & 0x7FFFFFFF
+        w = jax.random.normal(jax.random.PRNGKey(seed), (H, Dh, m), h.dtype)
+        scale = float(Dh) ** -0.25
+
+        def phi(x, stab):
+            proj = jnp.einsum("nhd,hdm->nhm", x * scale, w)
+            norm = 0.5 * jnp.sum((x * scale) ** 2, axis=-1, keepdims=True)
+            return jnp.exp(proj - norm - stab) / jnp.sqrt(float(m))
+
+        # stabilizers: per-row max for q (cancels in the ratio) and per-GRAPH
+        # max for k — uniform within a graph so it cancels exactly in num/den,
+        # and graph-local so no numerical coupling between graphs exists
+        G = batch.num_graphs
+        kproj = jnp.einsum("nhd,hdm->nhm", k * scale, w)
+        per_node = jax.lax.stop_gradient(kproj.max(axis=-1))  # [N, H]
+        per_graph = segment.segment_max(per_node, batch.batch, G)  # [G, H]
+        k_stab = per_graph[batch.batch][:, :, None]
+        qproj = jnp.einsum("nhd,hdm->nhm", q * scale, w)
+        q_stab = jax.lax.stop_gradient(qproj.max(axis=-1, keepdims=True))
+
+        qp = phi(q, q_stab)  # [N, H, m]
+        kp = phi(k, k_stab) * batch.node_mask[:, None, None]
+
+        kv = segment.segment_sum(
+            (kp[:, :, :, None] * v[:, :, None, :]).reshape(N, H * m * Dh),
+            batch.batch, G,
+        ).reshape(G, H, m, Dh)
+        z = segment.segment_sum(kp.reshape(N, H * m), batch.batch, G).reshape(G, H, m)
+
+        num = jnp.einsum("nhm,nhmd->nhd", qp, kv[batch.batch])
+        den = jnp.einsum("nhm,nhm->nh", qp, z[batch.batch])
+        out = num / jnp.maximum(den, 1e-9)[..., None]
+        out = out * batch.node_mask[:, None, None]
+        return nn.Dense(self.channels, name="out")(out.reshape(N, self.channels))
 
 
 class GPSConv(nn.Module):
@@ -86,9 +203,17 @@ class GPSConv(nn.Module):
             h_local = h_local + inv  # residual
         h_local = MaskedBatchNorm(name="norm1")(h_local, batch.node_mask, train)
 
-        h_attn = GraphMultiheadAttention(
-            channels=inv.shape[-1], heads=max(spec.global_attn_heads, 1), name="attn"
-        )(inv, batch, train)
+        if (spec.global_attn_type or "multihead") == "performer":
+            attn_mod = PerformerAttention(
+                channels=inv.shape[-1], heads=max(spec.global_attn_heads, 1),
+                name="attn",
+            )
+        else:
+            attn_mod = GraphMultiheadAttention(
+                channels=inv.shape[-1], heads=max(spec.global_attn_heads, 1),
+                n_max=spec.max_graph_nodes or 0, name="attn",
+            )
+        h_attn = attn_mod(inv, batch, train)
         h_attn = drop(h_attn, deterministic=not train)
         h_attn = h_attn + inv  # residual
         h_attn = MaskedBatchNorm(name="norm2")(h_attn, batch.node_mask, train)
