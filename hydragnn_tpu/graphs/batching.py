@@ -199,14 +199,69 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
     )
 
 
+def compute_pad_buckets(
+    samples: Sequence[GraphSample],
+    batch_size: int,
+    max_buckets: int = 4,
+    node_multiple: int = 8,
+    edge_multiple: int = 128,
+    quantiles: Sequence[float] = (0.5, 0.8, 0.95),
+    n_sim: int = 512,
+    seed: int = 0,
+) -> list[PadSpec]:
+    """Derive up to ``max_buckets`` padding buckets from the batch-total size
+    distribution (SURVEY §7 step 1: bucketed padding with a bounded compile
+    count). Buckets are quantile levels of simulated random batch totals; the
+    top bucket is the same worst-case bound ``compute_pad_spec`` gives, so any
+    batch always fits. Mixed-size datasets (the GFM case) collate most batches
+    to a much tighter bucket instead of the dataset-wide worst case."""
+    worst = compute_pad_spec(samples, batch_size, node_multiple, edge_multiple)
+    if len(samples) <= batch_size or max_buckets <= 1:
+        return [worst]
+    sizes = np.array(
+        [
+            (
+                s.num_nodes,
+                s.num_edges,
+                s.extras["idx_kj"].shape[0] if "idx_kj" in s.extras else 0,
+            )
+            for s in samples
+        ],
+        np.int64,
+    )
+    rng = np.random.default_rng(seed)
+    draws = rng.integers(0, len(samples), size=(n_sim, batch_size))
+    totals = sizes[draws].sum(axis=1)  # [n_sim, 3]
+    qs = list(quantiles)[: max_buckets - 1]
+    buckets: list[PadSpec] = []
+    for q in qs:
+        n, e, t = np.quantile(totals, q, axis=0)
+        spec = PadSpec(
+            n_node=min(_round_up(int(n) + 1, node_multiple), worst.n_node),
+            n_edge=min(_round_up(int(e), edge_multiple), worst.n_edge),
+            n_graph=batch_size + 1,
+            n_triplet=min(_round_up(int(t), edge_multiple), worst.n_triplet)
+            if worst.n_triplet
+            else 0,
+        )
+        if spec not in buckets and spec != worst:
+            buckets.append(spec)
+    buckets.append(worst)
+    return buckets
+
+
 class GraphLoader:
-    """Minimal host-side dataloader: shuffles, batches, collates to one bucket.
+    """Minimal host-side dataloader: shuffles, batches, collates to a bucket.
 
     The DistributedSampler semantics of the reference
     (``hydragnn/preprocess/load_data.py:252-282``) are reproduced by
     ``shard(rank, world)``: each process iterates a disjoint, equally-sized
     slice of the epoch permutation (padding the permutation to a multiple of
     ``world`` like torch's DistributedSampler does).
+
+    ``buckets``: optional ascending list of ``PadSpec``s (or an int asking for
+    that many derived via ``compute_pad_buckets``); each batch collates to the
+    smallest bucket that fits, bounding XLA program count by ``len(buckets)``.
     """
 
     def __init__(
@@ -219,12 +274,24 @@ class GraphLoader:
         drop_last: bool = True,
         rank: int = 0,
         world: int = 1,
+        buckets: int | Sequence[PadSpec] | None = None,
     ):
         self.samples = list(samples)
         if not self.samples and pad is None:
             raise ValueError("empty dataset needs an explicit pad spec")
         self.batch_size = int(batch_size)
-        self.pad = pad or compute_pad_spec(self.samples, self.batch_size)
+        if isinstance(buckets, int):
+            self.buckets = compute_pad_buckets(
+                self.samples, self.batch_size, max_buckets=buckets
+            )
+        elif buckets:
+            self.buckets = sorted(buckets, key=lambda p: p.as_tuple())
+        else:
+            self.buckets = None
+        if self.buckets:
+            self.pad = self.buckets[-1]
+        else:
+            self.pad = pad or compute_pad_spec(self.samples, self.batch_size)
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
@@ -232,10 +299,41 @@ class GraphLoader:
         self.world = world
         self.epoch = 0
 
+    def _pick_bucket(self, chunk: Sequence[GraphSample]) -> PadSpec:
+        if not self.buckets:
+            return self.pad
+        tot_n = sum(s.num_nodes for s in chunk)
+        tot_e = sum(s.num_edges for s in chunk)
+        tot_t = sum(
+            s.extras["idx_kj"].shape[0] for s in chunk if "idx_kj" in s.extras
+        )
+        for b in self.buckets:
+            if tot_n < b.n_node and tot_e <= b.n_edge and tot_t <= b.n_triplet:
+                return b
+        return self.buckets[-1]
+
+    def _step_bucket(self, step: int, perm: np.ndarray) -> PadSpec:
+        """Bucket for global step ``step``: the smallest bucket that fits
+        EVERY rank's batch at this step. Derived from the shared epoch
+        permutation, so all ranks make the identical choice and SPMD
+        collectives stay shape-aligned."""
+        picks = []
+        for r in range(self.world):
+            chunk = perm[r :: self.world][
+                step * self.batch_size : (step + 1) * self.batch_size
+            ]
+            picks.append(self._pick_bucket([self.samples[i] for i in chunk]))
+        # buckets are component-wise nested (quantile levels), so the largest
+        # per-rank pick fits every rank's batch
+        return max(picks, key=lambda p: p.as_tuple())
+
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)
 
-    def _epoch_indices(self) -> np.ndarray:
+    def _full_permutation(self) -> np.ndarray:
+        """The epoch permutation shared by all ranks, padded (by wrapping) to
+        a multiple of ``world``. Identical on every rank — both the per-rank
+        stride-slice and the per-step bucket choice derive from it."""
         n = len(self.samples)
         if n == 0:
             return np.zeros((0,), np.int64)
@@ -245,10 +343,14 @@ class GraphLoader:
         else:
             idx = np.arange(n)
         if self.world > 1:
-            # pad to a multiple of world by wrapping, then stride-slice
             total = int(math.ceil(n / self.world) * self.world)
             if total > n:
                 idx = np.concatenate([idx, idx[: total - n]])
+        return idx
+
+    def _epoch_indices(self) -> np.ndarray:
+        idx = self._full_permutation()
+        if self.world > 1:
             idx = idx[self.rank :: self.world]
         return idx
 
@@ -259,10 +361,87 @@ class GraphLoader:
         return int(math.ceil(n / self.batch_size))
 
     def __iter__(self) -> Iterable[GraphBatch]:
-        idx = self._epoch_indices()
+        perm = self._full_permutation()
+        idx = perm[self.rank :: self.world] if self.world > 1 else perm
         nb = len(self)
         for b in range(nb):
             chunk = idx[b * self.batch_size : (b + 1) * self.batch_size]
             if len(chunk) == 0:
                 break
-            yield collate([self.samples[i] for i in chunk], self.pad)
+            picked = [self.samples[i] for i in chunk]
+            pad = (
+                self._step_bucket(b, perm) if self.world > 1 else self._pick_bucket(picked)
+            )
+            yield collate(picked, pad)
+
+
+class PrefetchLoader:
+    """Double-buffering wrapper: a daemon thread runs collate (and optionally
+    the host→device transfer) ``depth`` batches ahead of the consumer, so the
+    chip never waits on the input pipeline. The reference gets this from its
+    threaded, core-pinned ``HydraDataLoader`` (``preprocess/load_data.py:
+    94-204``); here a queue + ``jax.device_put`` (async under dispatch) does
+    the same with no affinity games.
+    """
+
+    _DONE = object()
+
+    def __init__(self, loader, depth: int = 2, device_put: bool = True):
+        self.loader = loader
+        self.depth = max(1, int(depth))
+        self.device_put = device_put
+        # delegate loader state the epoch loop touches
+        self.samples = getattr(loader, "samples", [])
+        self.pad = getattr(loader, "pad", None)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.loader.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return len(self.loader)
+
+    def _transfer(self, batch):
+        if not self.device_put:
+            return batch
+        import jax
+
+        return jax.tree.map(jax.device_put, batch)
+
+    def __iter__(self):
+        import queue
+        import threading
+
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            """Blocking put that gives up when the consumer is gone."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for b in self.loader:
+                    if not put(self._transfer(b)):
+                        return
+                put(self._DONE)
+            except BaseException as exc:  # propagate into the consumer
+                put(exc)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()

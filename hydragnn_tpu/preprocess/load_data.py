@@ -166,21 +166,39 @@ def create_dataloaders(
     world: int = 1,
     pad: PadSpec | None = None,
     seed: int = 0,
+    buckets: int | None = None,
 ):
-    """Three loaders with a shared pad bucket (so all splits compile to the
-    same program) and DistributedSampler semantics on the train split."""
+    """Three loaders over a shared pad-bucket table (so the XLA program count
+    is bounded by the table size across all splits) and DistributedSampler
+    semantics on the train split. ``buckets > 1`` pads each batch to the
+    smallest of that many quantile-derived buckets instead of the dataset
+    worst case (``Training.pad_buckets``)."""
+    from ..graphs.batching import compute_pad_buckets
+
     all_samples = list(trainset) + list(valset) + list(testset)
     # never let drop_last starve training: a dataset smaller than the batch
     # still yields one (smaller) batch per epoch
     batch_size = max(1, min(batch_size, len(trainset) // max(world, 1) or 1))
+    bucket_list = (
+        compute_pad_buckets(all_samples, batch_size, max_buckets=buckets)
+        if buckets and buckets > 1
+        else None
+    )
     pad = pad or compute_pad_spec(all_samples, batch_size)
     train_loader = GraphLoader(
-        trainset, batch_size, pad=pad, shuffle=True, seed=seed, rank=rank, world=world
+        trainset, batch_size, pad=pad, shuffle=True, seed=seed, rank=rank, world=world,
+        buckets=bucket_list,
     )
     # val/test may legitimately be empty (tiny datasets, perc_train=1.0);
     # the train loop skips evaluation then
-    val_loader = GraphLoader(valset, batch_size, pad=pad, drop_last=False, rank=rank, world=world)
-    test_loader = GraphLoader(testset, batch_size, pad=pad, drop_last=False, rank=rank, world=world)
+    val_loader = GraphLoader(
+        valset, batch_size, pad=pad, drop_last=False, rank=rank, world=world,
+        buckets=bucket_list,
+    )
+    test_loader = GraphLoader(
+        testset, batch_size, pad=pad, drop_last=False, rank=rank, world=world,
+        buckets=bucket_list,
+    )
     return train_loader, val_loader, test_loader
 
 
@@ -241,4 +259,7 @@ def dataset_loading_and_splitting(config: dict, samples=None, rank: int = 0, wor
         stratify_splitting=config["Dataset"].get("compositional_stratified_splitting", False),
     )
     bs = int(training.get("batch_size", 32))
-    return create_dataloaders(train, val, test, bs, rank=rank, world=world)
+    return create_dataloaders(
+        train, val, test, bs, rank=rank, world=world,
+        buckets=int(training.get("pad_buckets", 0) or 0) or None,
+    )

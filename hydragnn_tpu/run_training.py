@@ -25,6 +25,26 @@ from .utils.print_utils import print_distributed, setup_log
 def run_training(config_source, samples: Sequence | None = None, rank: int = 0, world: int = 1):
     config = load_config(config_source)
     verbosity = config.get("Verbosity", {}).get("level", 0)
+    training_cfg = config.get("NeuralNetwork", {}).get("Training", {})
+
+    # the in-process mesh path stacks device-count groups of batches, which
+    # must share one shape — bucketed padding only applies off that path
+    will_mesh = False
+    try:
+        import jax
+
+        will_mesh = (
+            os.getenv("HYDRAGNN_AUTO_PARALLEL", "1") != "0" and len(jax.devices()) > 1
+        )
+    except Exception:
+        pass
+    if will_mesh and training_cfg.get("pad_buckets"):
+        print_distributed(
+            verbosity, "pad_buckets disabled: multi-device grouping needs one bucket"
+        )
+        training_cfg = dict(training_cfg)
+        config["NeuralNetwork"]["Training"] = training_cfg
+        training_cfg["pad_buckets"] = 0
 
     # data loading + split (reference :90)
     train_loader, val_loader, test_loader = dataset_loading_and_splitting(
@@ -97,6 +117,21 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     # walltime guard (reference distributed.py:614-639): stop before SLURM
     # kills the job so the best checkpoint survives
     from .utils.walltime import make_walltime_check
+
+    # input-pipeline prefetch (reference HydraDataLoader's threaded prefetch,
+    # load_data.py:94-204): collate + host->device transfer run a couple of
+    # batches ahead of the step loop. Training.prefetch / HYDRAGNN_PREFETCH
+    # set the depth; 0 disables.
+    depth = int(os.getenv("HYDRAGNN_PREFETCH", training_cfg.get("prefetch", 2)))
+    if depth > 0:
+        from .graphs.batching import PrefetchLoader
+
+        # under a mesh the loop stacks host batches itself: prefetch the
+        # collate work but leave device placement to put_batch
+        dput = mesh is None
+        train_loader = PrefetchLoader(train_loader, depth=depth, device_put=dput)
+        val_loader = PrefetchLoader(val_loader, depth=depth, device_put=dput)
+        test_loader = PrefetchLoader(test_loader, depth=depth, device_put=dput)
 
     state = train_validate_test(
         model,

@@ -58,17 +58,16 @@ class OversamplingLoader(GraphLoader):
         super().__init__(samples, batch_size, shuffle=True, **kw)
         self.num_samples = int(num_samples)
 
-    def _epoch_indices(self) -> np.ndarray:
+    def _full_permutation(self) -> np.ndarray:
+        """Replacement draw shared by all ranks (the base class stride-slices
+        it per rank and derives per-step buckets from it). Drawn as a multiple
+        of world so every rank gets the same batch count — unequal counts
+        deadlock the SPMD all-reduce."""
         rng = np.random.default_rng(self.seed + self.epoch)
-        # draw a multiple of world so every rank gets the same batch count
-        # (unequal counts deadlock the SPMD all-reduce)
         total = self.num_samples
         if self.world > 1:
             total = int(np.ceil(total / self.world) * self.world)
-        idx = rng.choice(len(self.samples), size=total, replace=True)
-        if self.world > 1:
-            idx = idx[self.rank :: self.world]
-        return idx
+        return rng.choice(len(self.samples), size=total, replace=True)
 
 
 def make_branch_loaders(

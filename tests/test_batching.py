@@ -127,3 +127,144 @@ def test_empty_split_trains_without_valtest():
     samples = deterministic_graph_data(number_configurations=20, seed=4)
     state, model, aug = hydragnn_tpu.run_training(cfg, samples=samples)
     assert state.step > 0
+
+
+# ---------- bucketed padding (SURVEY §7 step 1) ----------
+
+
+def mixed_size_samples(n=200, seed=0):
+    """Bimodal dataset: many small molecules + a few big crystals — the GFM
+    mix where a single worst-case bucket wastes most of every step."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        big = rng.uniform() < 0.1
+        nn_ = int(rng.integers(40, 60)) if big else int(rng.integers(8, 16))
+        ee = nn_ * 6
+        out.append(make_sample(nn_, ee, seed=int(rng.integers(1 << 30))))
+    return out
+
+
+def test_pad_buckets_bounded_and_fitting():
+    from hydragnn_tpu.graphs.batching import compute_pad_buckets
+
+    samples = mixed_size_samples()
+    buckets = compute_pad_buckets(samples, batch_size=16, max_buckets=4)
+    assert 1 <= len(buckets) <= 4
+    # component-wise nested so the largest per-rank pick fits all ranks
+    for a, b in zip(buckets, buckets[1:]):
+        assert a.n_node <= b.n_node and a.n_edge <= b.n_edge
+    loader = GraphLoader(samples, 16, shuffle=True, buckets=buckets)
+    seen = set()
+    for batch in loader:
+        seen.add(batch.x.shape[0])
+        assert batch.node_mask.sum() < batch.x.shape[0]  # reserved pad node
+    assert len(seen) <= 4  # compile count bounded by bucket table
+
+
+def test_pad_buckets_reduce_padding_waste():
+    samples = mixed_size_samples()
+    single = GraphLoader(samples, 16, shuffle=True)
+    bucketed = GraphLoader(samples, 16, shuffle=True, buckets=4)
+
+    def waste(loader):
+        tot_slots = tot_real = 0
+        for b in loader:
+            tot_slots += b.x.shape[0]
+            tot_real += int(b.node_mask.sum())
+        return 1.0 - tot_real / tot_slots
+
+    w_single, w_bucketed = waste(single), waste(bucketed)
+    assert w_bucketed < w_single * 0.8, (w_single, w_bucketed)
+
+
+def test_bucket_choice_identical_across_ranks():
+    """SPMD safety: every rank must pick the same bucket at the same step."""
+    samples = mixed_size_samples()
+    shapes = []
+    for rank in (0, 1):
+        loader = GraphLoader(
+            samples, 8, shuffle=True, seed=3, rank=rank, world=2, buckets=4
+        )
+        loader.set_epoch(5)
+        shapes.append([b.x.shape[0] for b in loader])
+    assert shapes[0] == shapes[1]
+
+
+def test_bucketed_loader_bounded_compile_count():
+    import jax
+    import jax.numpy as jnp
+
+    samples = mixed_size_samples(120)
+    loader = GraphLoader(samples, 16, shuffle=True, buckets=3)
+    traces = []
+
+    @jax.jit
+    def pool(x, mask):
+        traces.append(x.shape)
+        return (x * mask[:, None]).sum()
+
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        for b in loader:
+            pool(jnp.asarray(b.x), jnp.asarray(b.node_mask))
+    assert len(traces) <= 3, f"recompile churn: {traces}"
+
+
+# ---------- prefetch pipeline ----------
+
+
+def test_prefetch_loader_matches_direct_iteration():
+    from hydragnn_tpu.graphs.batching import PrefetchLoader
+
+    samples = [make_sample(6, 12, seed=i) for i in range(32)]
+    loader = GraphLoader(samples, 4, shuffle=True, seed=7)
+    direct = [b.x for b in loader]
+    pre = PrefetchLoader(GraphLoader(samples, 4, shuffle=True, seed=7), depth=3,
+                         device_put=False)
+    got = [b.x for b in pre]
+    assert len(direct) == len(got)
+    for a, b in zip(direct, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prefetch_loader_early_break_does_not_leak_threads():
+    import threading
+    import time
+
+    from hydragnn_tpu.graphs.batching import PrefetchLoader
+
+    samples = [make_sample(6, 12, seed=i) for i in range(64)]
+    pre = PrefetchLoader(GraphLoader(samples, 4), depth=2, device_put=False)
+    for _ in range(5):
+        for b in pre:
+            break  # consumer abandons mid-epoch
+    time.sleep(1.0)  # workers observe stop and exit
+    leaked = [
+        t for t in threading.enumerate() if t.daemon and "Thread-" in t.name and t.is_alive()
+    ]
+    assert len(leaked) <= 1, f"leaked prefetch workers: {leaked}"
+    # and the loader still works for a full pass afterwards
+    assert len([b for b in pre]) == len(GraphLoader(samples, 4))
+
+
+def test_prefetch_loader_propagates_worker_exception():
+    from hydragnn_tpu.graphs.batching import PrefetchLoader
+
+    class Boom:
+        samples = []
+        pad = None
+
+        def __iter__(self):
+            yield make_sample(4, 8)
+            raise RuntimeError("collate exploded")
+
+        def __len__(self):
+            return 2
+
+        def set_epoch(self, e):
+            pass
+
+    pre = PrefetchLoader(Boom(), depth=2, device_put=False)
+    with pytest.raises(RuntimeError, match="collate exploded"):
+        list(pre)
