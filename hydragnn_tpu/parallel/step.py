@@ -42,6 +42,21 @@ def stack_device_batches(batches: list[GraphBatch]) -> GraphBatch:
     return GraphBatch(*[np.stack(f) for f in zip(*batches)])
 
 
+def _spans_processes(mesh: Mesh) -> bool:
+    return mesh.devices.size > len(mesh.local_devices)
+
+
+def _place(x, mesh: Mesh, spec: P):
+    """Place a host array with ``spec`` on a mesh that may span processes.
+    Multi-process meshes can't take a plain ``device_put`` of host data, so
+    each process contributes its addressable shards via the callback API."""
+    sharding = NamedSharding(mesh, spec)
+    if not _spans_processes(mesh):
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
 def shard_state(state: TrainState, mesh: Mesh, param_mode: str = "replicated") -> TrainState:
     """Place a TrainState on the mesh (replicated or FSDP-sharded params;
     optimizer state follows the param sharding — ZeRO-1 for free)."""
@@ -51,16 +66,10 @@ def shard_state(state: TrainState, mesh: Mesh, param_mode: str = "replicated") -
         pspecs = jax.tree.map(lambda _: P(), state.params)
 
     def put(tree, specs):
-        return jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
-        )
+        return jax.tree.map(lambda x, s: _place(x, mesh, s), tree, specs)
 
     params = put(state.params, pspecs)
-    stats = jax.tree.map(lambda x: jax.device_put(x, NamedSharding(mesh, P())), state.batch_stats)
-
-    def opt_spec_for(x):
-        # optimizer moments mirror the param tree where shapes match
-        return P()
+    stats = jax.tree.map(lambda x: _place(x, mesh, P()), state.batch_stats)
 
     # shard optimizer state leaves that match a param's shape with that
     # param's spec; everything else replicated
@@ -72,11 +81,11 @@ def shard_state(state: TrainState, mesh: Mesh, param_mode: str = "replicated") -
     def place_opt(x):
         if hasattr(x, "shape"):
             s = shape_to_spec.get((x.shape, x.dtype), P())
-            return jax.device_put(x, NamedSharding(mesh, s))
+            return _place(x, mesh, s)
         return x
 
     opt_state = jax.tree.map(place_opt, state.opt_state)
-    step = jax.device_put(state.step, NamedSharding(mesh, P()))
+    step = _place(np.asarray(state.step), mesh, P())
     return TrainState(params=params, batch_stats=stats, opt_state=opt_state, step=step)
 
 
@@ -86,7 +95,18 @@ def batch_shardings(mesh: Mesh) -> GraphBatch:
 
 
 def put_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
-    """Device-put a stacked [D, ...] batch with leading axis over data."""
+    """Device-put a stacked batch with leading axis over data.
+
+    Single process: ``batch`` carries the full ``[D, ...]`` leading axis.
+    Multi-process: each process passes its LOCAL ``[D_local, ...]`` stack and
+    the global array is assembled shard-by-shard (the jax.distributed data
+    path replacing the reference's per-rank DataLoader + NCCL allreduce)."""
+    if _spans_processes(mesh):
+        data_sh = NamedSharding(mesh, P(DATA_AXIS))
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(data_sh, np.asarray(x)),
+            batch,
+        )
     sh = batch_shardings(mesh)
     return jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s), batch, sh)
 
@@ -165,6 +185,51 @@ def make_parallel_eval_step(model: HydraModel, mesh: Mesh, compute_dtype=jnp.flo
             return tot * ng, jnp.stack(tasks) * ng, jnp.stack(sses), jnp.stack(counts), ng
 
         tots, tasks, sses, counts, ngs = jax.vmap(per_device)(c_batches)
+        denom = jnp.maximum(ngs.sum(), 1.0)
+        return {
+            "loss": tots.sum() / denom,
+            "tasks_loss": tasks.sum(axis=0) / denom,
+            "head_sse": sses.sum(axis=0),
+            "head_count": counts.sum(axis=0),
+            "num_graphs": ngs.sum(),
+        }
+
+    return eval_step
+
+
+def make_parallel_mlip_eval_step(model: HydraModel, mesh: Mesh, compute_dtype=jnp.float32):
+    """Vmapped SPMD MLIP evaluation — all device shards in one program
+    (replaces the sequential per-device host loop; same bookkeeping as
+    ``make_parallel_eval_step``)."""
+    from ..models.mlip import energy_force_loss, make_energy_and_forces
+
+    spec = model.spec
+    energy_and_forces = make_energy_and_forces(model)
+
+    @jax.jit
+    def eval_step(state: TrainState, batches: GraphBatch):
+        c_params = _cast_floats(state.params, compute_dtype)
+        c_batches = _cast_floats(batches, compute_dtype)
+
+        def per_device(b, b_raw):
+            variables = {"params": c_params, "batch_stats": state.batch_stats}
+            graph_e, forces = energy_and_forces(variables, b, False)
+            graph_e = graph_e.astype(jnp.float32)
+            forces = forces.astype(jnp.float32)
+            tot, tasks = energy_force_loss(spec, graph_e, forces, b_raw)
+            gm = b_raw.graph_mask
+            e_sse = (((graph_e - b_raw.energy_y[:, 0]) ** 2) * gm).sum()
+            f_sse = (((forces - b_raw.forces_y) ** 2) * b_raw.node_mask[:, None]).sum()
+            ng = gm.sum()
+            return (
+                tot * ng,
+                jnp.stack(tasks) * ng,
+                jnp.stack([e_sse, f_sse]),
+                jnp.stack([ng, b_raw.node_mask.sum() * 3]),
+                ng,
+            )
+
+        tots, tasks, sses, counts, ngs = jax.vmap(per_device)(c_batches, batches)
         denom = jnp.maximum(ngs.sum(), 1.0)
         return {
             "loss": tots.sum() / denom,

@@ -27,6 +27,18 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     verbosity = config.get("Verbosity", {}).get("level", 0)
     training_cfg = config.get("NeuralNetwork", {}).get("Training", {})
 
+    # multi-host bootstrap (reference setup_ddp, distributed.py:151-280):
+    # scheduler env cascade -> jax.distributed.initialize; no-op/idempotent in
+    # single-process runs. Caller-supplied rank/world win if explicit.
+    if world == 1:
+        from .parallel.distributed import setup_ddp
+
+        try:
+            world, rank = setup_ddp(verbosity)
+        except Exception as e:
+            print_distributed(verbosity, f"multi-host init skipped ({e})")
+            world, rank = 1, 0
+
     # the in-process mesh path stacks device-count groups of batches, which
     # must share one shape — bucketed padding only applies off that path
     will_mesh = False
@@ -67,6 +79,23 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     example = next(iter(train_loader))
     state = create_train_state(model, optimizer, example)
 
+    # resume (reference load_existing_model_config, model.py:202-216):
+    # Training.continue truthy -> restore model+optimizer from the run named
+    # by Training.startfrom (default: this run's log name)
+    if training_cfg.get("continue"):
+        from .train.checkpoint import load_checkpoint
+
+        startfrom = training_cfg.get("startfrom", log_name)
+        try:
+            state, meta = load_checkpoint(state, startfrom)
+            print_distributed(
+                verbosity, f"resumed from {startfrom} (epoch {meta.get('epoch')})"
+            )
+        except FileNotFoundError as e:
+            raise FileNotFoundError(
+                f"Training.continue set but no checkpoint under logs/{startfrom}: {e}"
+            )
+
     # auto-scale to every local device: one SPMD program over a 1D data mesh
     # (HYDRAGNN_AUTO_PARALLEL=0 forces single-device; HYDRAGNN_USE_FSDP=1
     # shards params/optimizer state — the reference's FSDP/ZeRO env knobs)
@@ -74,11 +103,12 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     try:
         import jax
 
-        n_dev = len(jax.devices())
+        n_dev = len(jax.devices())  # global (all processes)
+        n_local = len(jax.local_devices())
         if (
             os.getenv("HYDRAGNN_AUTO_PARALLEL", "1") != "0"
             and n_dev > 1
-            and len(train_loader) >= n_dev
+            and len(train_loader) >= n_local
         ):
             from .parallel import make_mesh, shard_state
 
@@ -149,6 +179,20 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     )
     if writer is not None:
         writer.close()
+
+    # always save the final model (reference run_training.py:206 save_model);
+    # resumable via Training.continue + startfrom=<log_name>
+    try:
+        from .train.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            state,
+            log_name,
+            epoch=int(config["NeuralNetwork"]["Training"].get("num_epoch", 0)),
+            meta={"final": True},
+        )
+    except Exception as e:  # a failed save must not kill a finished training
+        print_distributed(verbosity, f"final model save failed: {e}")
 
     # end-of-run visualization (reference train_validate_test :441-491)
     if config.get("Visualization", {}).get("create_plots"):

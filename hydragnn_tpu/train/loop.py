@@ -70,13 +70,19 @@ def _grouped(loader, n: int, mesh, fill: bool = False):
         yield put_batch(stack_device_batches(group), mesh)
 
 
+def _local_device_count(mesh) -> int:
+    """Batches grouped per step on THIS process: each process stacks only its
+    addressable devices' shard; put_batch assembles the global array."""
+    return len(mesh.local_devices)
+
+
 def train_epoch(train_step, state: TrainState, loader, verbosity: int = 0, mesh=None):
     """One training epoch; returns (state, mean loss, per-task mean losses)."""
     tot = 0.0
     tasks = None
     n_graphs = 0.0
     nbatch = _max_num_batches(loader)
-    n_dev = mesh.devices.size if mesh is not None else 1
+    n_dev = _local_device_count(mesh) if mesh is not None else 1
     if mesh is not None:
         # the HYDRAGNN_MAX_NUM_BATCH cap counts raw loader batches; each
         # grouped step consumes n_dev of them
@@ -114,7 +120,7 @@ def evaluate(
     sse = None
     count = None
     n_graphs = 0.0
-    n_dev = mesh.devices.size if mesh is not None else 1
+    n_dev = _local_device_count(mesh) if mesh is not None else 1
     it = (
         _grouped(loader, n_dev, mesh, fill=True)
         if mesh is not None
@@ -177,38 +183,10 @@ def train_validate_test(
             model, optimizer, mesh, compute_dtype=precision
         )
         if model.spec.enable_interatomic_potential:
-            # MLIP eval runs per device shard, merged with graph-count
-            # weighting (matching the non-MLIP parallel eval's bookkeeping)
-            from ..models.mlip import make_mlip_eval_step
+            # vmapped SPMD MLIP eval — one program over all device shards
+            from ..parallel.step import make_parallel_mlip_eval_step
 
-            eval_step_single = make_mlip_eval_step(model, compute_dtype=precision)
-
-            def eval_step(state, batches):
-                import jax as _jax
-
-                sse = cnt = tasks = None
-                tot = 0.0
-                ng_sum = 0.0
-                n = _jax.tree.leaves(batches)[0].shape[0]
-                for d in range(n):
-                    b = _jax.tree.map(lambda x: x[d], batches)
-                    m = eval_step_single(state, b)
-                    ng = m["num_graphs"]
-                    tot = tot + m["loss"] * ng
-                    t = m["tasks_loss"] * ng
-                    tasks = t if tasks is None else tasks + t
-                    sse = m["head_sse"] if sse is None else sse + m["head_sse"]
-                    cnt = m["head_count"] if cnt is None else cnt + m["head_count"]
-                    ng_sum = ng_sum + ng
-                denom = jnp.maximum(ng_sum, 1.0)
-                return {
-                    "loss": tot / denom,
-                    "tasks_loss": tasks / denom,
-                    "head_sse": sse,
-                    "head_count": cnt,
-                    "num_graphs": ng_sum,
-                }
-
+            eval_step = make_parallel_mlip_eval_step(model, mesh, compute_dtype=precision)
         else:
             eval_step = make_parallel_eval_step(model, mesh, compute_dtype=precision)
 

@@ -1,0 +1,131 @@
+"""Worker process for the 2-process distributed CI gate (not a test module).
+
+The reference runs its whole suite under ``mpirun -n 2``
+(``.github/workflows/CI.yml:53-67``); the JAX equivalent is two OS processes
+joined by ``jax.distributed`` into one global 2-device CPU platform, running
+the real ``run_training`` entry end-to-end with per-process data sharding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+CONFIG = {
+    "Verbosity": {"level": 0},
+    "Dataset": {
+        "name": "dist2proc",
+        "format": "unit_test",
+        "node_features": {
+            "name": ["type", "x", "x2", "x3"],
+            "dim": [1, 1, 1, 1],
+            "column_index": [0, 1, 2, 3],
+        },
+        "graph_features": {"name": ["sum"], "dim": [1], "column_index": [0]},
+    },
+    "NeuralNetwork": {
+        "Architecture": {
+            "mpnn_type": "GIN",
+            "radius": 2.0,
+            "max_neighbours": 20,
+            "hidden_dim": 16,
+            "num_conv_layers": 2,
+            "output_heads": {
+                "graph": {
+                    "num_sharedlayers": 1,
+                    "dim_sharedlayers": 8,
+                    "num_headlayers": 1,
+                    "dim_headlayers": [16],
+                }
+            },
+            "task_weights": [1.0],
+        },
+        "Variables_of_interest": {
+            "input_node_features": [0],
+            "output_index": [0],
+            "type": ["graph"],
+            "denormalize_output": False,
+        },
+        "Training": {
+            "num_epoch": 3,
+            "batch_size": 4,
+            "perc_train": 0.8,
+            "loss_function_type": "mse",
+            "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+        },
+    },
+}
+
+
+def main() -> None:
+    rank, world, port, outdir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    mode = sys.argv[5] if len(sys.argv) > 5 else "inmem"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=world, process_id=rank
+    )
+    assert jax.process_count() == world, jax.process_count()
+    assert len(jax.devices()) == world  # one CPU device per process
+    assert len(jax.local_devices()) == 1
+
+    import numpy as np
+
+    import hydragnn_tpu
+    from hydragnn_tpu.datasets import deterministic_graph_data
+
+    os.chdir(outdir)
+    samples = deterministic_graph_data(number_configurations=48, seed=5)
+
+    if mode == "packed":
+        # cross-host data plane: rank 0 writes the packed store, a global
+        # barrier publishes it, then EVERY rank reads lazily with per-epoch
+        # global shuffle (the DDStore-equivalent path)
+        from jax.experimental import multihost_utils
+
+        from hydragnn_tpu.datasets.packed import GlobalShuffleStore, PackedWriter
+
+        path = os.path.join(outdir, "train.gpk")
+        if rank == 0:
+            PackedWriter(samples, path)
+        multihost_utils.sync_global_devices("packed_write_done")
+        store = GlobalShuffleStore(path)
+        assert len(store) == len(samples)
+        # per-epoch stream check: this rank's sample ids change across epochs
+        # and the two ranks' streams partition the whole file each epoch
+        ld = store.loader(batch_size=4, rank=rank, world=world, seed=9)
+        ids = {}
+        for epoch in (0, 1):
+            ld.set_epoch(epoch)
+            ids[epoch] = list(ld._epoch_indices())
+        assert ids[0] != ids[1], "host stream frozen across epochs"
+        gathered = multihost_utils.process_allgather(
+            np.array(ids[0] + ids[1], np.int32)
+        )
+        for ep in (0, 1):
+            sl = slice(0, len(ids[0])) if ep == 0 else slice(len(ids[0]), None)
+            union = set(gathered[0][sl].tolist()) | set(gathered[1][sl].tolist())
+            assert union == set(range(len(samples))), "epoch doesn't span the store"
+        samples = store
+
+    state, model, config = hydragnn_tpu.run_training(CONFIG, samples=samples)
+
+    # params are replicated; every process must hold identical values
+    total = 0.0
+    for leaf in jax.tree.leaves(state.params):
+        shard = np.asarray(leaf.addressable_shards[0].data)
+        total += float(np.abs(shard).sum())
+    with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "param_l1": total}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""2-process distributed CI gate: the reference's ``mpirun -n 2`` suite run
+(``.github/workflows/CI.yml:53-67``) as two ``jax.distributed`` CPU processes
+driving the real ``run_training`` — exercises ``jax.distributed.initialize``,
+per-process data sharding (``GraphLoader(rank, world)``), the multi-process
+``put_batch`` path, and cross-process metric consistency.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_workers(tmp_path, mode: str):
+    worker = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
+    port = _free_port()
+    env = dict(os.environ)
+    # one real CPU device per process; the worker pins platforms itself
+    env["XLA_FLAGS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HYDRAGNN_AUTO_PARALLEL"] = "1"
+    env["HYDRAGNN_TENSORBOARD"] = "0"
+    env.pop("JAX_NUM_PROCESSES", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(rank), "2", str(port), str(tmp_path), mode],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+
+    results = {}
+    for rank in (0, 1):
+        with open(tmp_path / f"rank{rank}.json") as f:
+            results[rank] = json.load(f)
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_training_end_to_end(tmp_path):
+    results = _run_workers(tmp_path, "inmem")
+    # replicated params must be bit-consistent across processes — proof the
+    # two processes executed one aligned SPMD program with a global grad sync
+    assert results[0]["param_l1"] == pytest.approx(results[1]["param_l1"], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_training_from_packed_store(tmp_path):
+    """Cross-host data plane (DDStore equivalent): rank 0 writes the packed
+    store, both ranks train from it with per-epoch GLOBAL shuffle — the
+    worker asserts each host's stream changes across epochs and that the
+    ranks partition the whole store every epoch."""
+    results = _run_workers(tmp_path, "packed")
+    assert results[0]["param_l1"] == pytest.approx(results[1]["param_l1"], rel=1e-6)
