@@ -100,8 +100,17 @@ class PackedWriter:
             offset += data.nbytes
             payload.append((counts, data))
 
+        # size stats let loaders build pad specs without a full scan
+        final_attrs = dict(attrs or {})
+        if samples:
+            final_attrs.setdefault(
+                "max_nodes", int(max(s.num_nodes for s in samples))
+            )
+            final_attrs.setdefault(
+                "max_edges", int(max(s.num_edges for s in samples))
+            )
         header = json.dumps(
-            {"n_samples": n, "keys": keys, "attrs": attrs or {}}
+            {"n_samples": n, "keys": keys, "attrs": final_attrs}
         ).encode()
         with open(path, "wb") as f:
             f.write(MAGIC)
@@ -192,3 +201,79 @@ class PackedDataset:
         """Per-rank shard window (AdiosDataset.setsubset semantics)."""
         self.subset = range(start, stop)
         return self
+
+
+class GlobalShuffleStore:
+    """DDStore-equivalent cross-host sample store (reference
+    ``hydragnn/utils/datasets/distdataset.py:72-367`` and AdiosDataset's
+    remote-read mode ``adiosdataset.py:643-757``).
+
+    The reference needs an in-RAM distributed store with remote ``get()``
+    fetches because each rank materializes only its window of the dataset.
+    The packed format already gives every host O(1) random access to ANY
+    sample by offset (mmap + count/offset index; the OS page cache is the
+    shared RAM tier), so cross-host global shuffle needs no message passing
+    at all: every rank derives the SAME per-epoch permutation from the shared
+    seed and lazily reads its stride-slice — the "index exchange" is
+    deterministic replay instead of communication.
+
+    This object is a lazy Sequence over the whole file: feed it straight to
+    ``GraphLoader(..., rank, world, shuffle=True)`` and each host's stream
+    (a) spans the entire dataset across epochs instead of a fixed window and
+    (b) reshuffles globally every epoch — the two DDStore properties the
+    per-host ``setsubset`` windows lack.
+    """
+
+    def __init__(self, path: str):
+        self.ds = PackedDataset(path)
+
+    def __len__(self) -> int:
+        return self.ds.meta["n_samples"]
+
+    def __getitem__(self, i: int) -> GraphSample:
+        return self.ds[int(i)]
+
+    @property
+    def attrs(self) -> dict:
+        return self.ds.attrs
+
+    def pad_spec(self, batch_size: int, node_multiple: int = 8, edge_multiple: int = 128):
+        """PadSpec from writer-recorded size stats — no full scan."""
+        from ..graphs.batching import PadSpec
+
+        a = self.attrs
+        if "max_nodes" not in a:
+            raise ValueError("packed file lacks size stats; re-write with PackedWriter")
+        import math
+
+        def up(v, m):
+            return int(math.ceil(max(v, 1) / m) * m)
+
+        return PadSpec(
+            n_node=up(a["max_nodes"] * batch_size + 1, node_multiple),
+            n_edge=up(a["max_edges"] * batch_size + 1, edge_multiple),
+            n_graph=batch_size + 1,
+        )
+
+    def loader(
+        self,
+        batch_size: int,
+        rank: int = 0,
+        world: int = 1,
+        seed: int = 0,
+        shuffle: bool = True,
+        pad=None,
+        **kw,
+    ):
+        from ..graphs.batching import GraphLoader
+
+        return GraphLoader(
+            self,
+            batch_size,
+            pad=pad or self.pad_spec(batch_size),
+            shuffle=shuffle,
+            seed=seed,
+            rank=rank,
+            world=world,
+            **kw,
+        )

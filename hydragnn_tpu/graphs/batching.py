@@ -276,8 +276,14 @@ class GraphLoader:
         world: int = 1,
         buckets: int | Sequence[PadSpec] | None = None,
     ):
-        self.samples = list(samples)
-        if not self.samples and pad is None:
+        # lazy stores (PackedDataset/GlobalShuffleStore) are kept by reference
+        # so samples load on access; plain iterables are materialized
+        if isinstance(samples, (list, tuple)) or not (
+            hasattr(samples, "__getitem__") and hasattr(samples, "__len__")
+        ):
+            samples = list(samples)
+        self.samples = samples
+        if not len(self.samples) and pad is None:
             raise ValueError("empty dataset needs an explicit pad spec")
         self.batch_size = int(batch_size)
         if isinstance(buckets, int):

@@ -254,3 +254,34 @@ def test_z_field_survives_normalization():
     b = collate(samples, pad)
     real_z = np.asarray(b.z)[np.asarray(b.node_mask) > 0]
     assert set(real_z.tolist()) == {26, 78}, "raw Z lost in normalization"
+
+
+def test_global_shuffle_store_lazy_and_spans(tmp_path):
+    """DDStore-equivalent store: lazy random access, pad spec from writer
+    stats (no scan), per-epoch global reshuffle through GraphLoader."""
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.datasets.packed import GlobalShuffleStore, PackedWriter
+
+    samples = deterministic_graph_data(number_configurations=24, seed=2)
+    path = str(tmp_path / "store.gpk")
+    PackedWriter(samples, path)
+    store = GlobalShuffleStore(path)
+    assert len(store) == 24
+    assert store.attrs["max_nodes"] >= max(s.num_nodes for s in samples) - 1
+    pad = store.pad_spec(batch_size=4)
+    assert pad.n_graph == 5
+
+    loaders = [store.loader(4, rank=r, world=2, seed=1) for r in (0, 1)]
+    streams = {}
+    for r, ld in enumerate(loaders):
+        assert ld.samples is store  # lazy: no eager materialization
+        for epoch in (0, 1):
+            ld.set_epoch(epoch)
+            streams[(r, epoch)] = list(ld._epoch_indices())
+    for epoch in (0, 1):
+        union = set(streams[(0, epoch)]) | set(streams[(1, epoch)])
+        assert union == set(range(24))  # ranks partition the whole store
+    assert streams[(0, 0)] != streams[(0, 1)]  # stream changes across epochs
+
+    batch = next(iter(loaders[0]))
+    assert batch.graph_mask.sum() == 4
