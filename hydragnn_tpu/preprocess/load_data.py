@@ -212,6 +212,12 @@ def dataset_loading_and_splitting(config: dict, samples=None, rank: int = 0, wor
 
         samples = load_raw_dataset(config)
     training = config.setdefault("NeuralNetwork", {}).setdefault("Training", {})
+    # rotation normalization BEFORE edge construction (reference
+    # serialized_dataset_loader.py:130-132, Dataset.rotational_invariance)
+    if config["Dataset"].get("rotational_invariance"):
+        from .transforms import normalize_rotation
+
+        samples = [normalize_rotation(s) for s in samples]
     # raw-format samples arrive without neighbor lists: build radius graphs
     # from the architecture's cutoff (reference SerializedDataLoader
     # ``load_serialized_data`` radius-graph pass, serialized_dataset_loader.py:134-150)
@@ -225,7 +231,36 @@ def dataset_loading_and_splitting(config: dict, samples=None, rank: int = 0, wor
                 build_radius_graph(
                     s, float(radius), max_neighbours=arch_pre.get("max_neighbours")
                 )
+    # edge-length + geometric descriptor columns (reference :152-180):
+    # Distance(cat=True) + dataset/processes-global max normalization, then
+    # Spherical / PointPairFeatures appended to edge_attr
+    desc_cfg = config["Dataset"].get("Descriptors", {}) or {}
+    if config["Dataset"].get("compute_edge_lengths"):
+        from .transforms import attach_edge_lengths, normalize_edge_lengths_global
+
+        for s in samples:
+            attach_edge_lengths(s)
+        normalize_edge_lengths_global(samples)
+    if desc_cfg.get("spherical_coordinates"):
+        from .transforms import spherical_features
+
+        for s in samples:
+            spherical_features(s)
+    if desc_cfg.get("point_pair_features"):
+        from .transforms import point_pair_features
+
+        for s in samples:
+            point_pair_features(s)
+
     samples = apply_variables_of_interest(samples, config)
+    # stratified composition subsampling (reference :214-259)
+    sub_pct = config["NeuralNetwork"].get("Variables_of_interest", {}).get(
+        "subsample_percentage"
+    )
+    if sub_pct:
+        from .transforms import stratified_subsample
+
+        samples = stratified_subsample(samples, float(sub_pct))
     arch_cfg = config["NeuralNetwork"].get("Architecture", {})
     if arch_cfg.get("mpnn_type") == "DimeNet":
         # DimeNet needs host-precomputed angle (triplet) indices

@@ -1,0 +1,178 @@
+"""Geometric preprocessing transforms (host-side numpy).
+
+Parity targets from the reference's ``SerializedDataLoader.load_serialized_data``
+(``hydragnn/preprocess/serialized_dataset_loader.py:110-259``), which applies
+PyG transforms:
+
+* ``normalize_rotation``   — PyG ``NormalizeRotation`` (:130-132): rotate each
+  structure into its PCA frame so the dataset is rotation-normalized.
+* ``attach_edge_lengths`` / ``normalize_edge_lengths_global`` — PyG
+  ``Distance(norm=False, cat=True)`` + dataset-global max normalization with a
+  cross-process MAX all-reduce (:152-173).
+* ``spherical_features``   — PyG ``Spherical`` (:176-177): per-edge
+  (rho, theta, phi) of the relative position, normalized, appended.
+* ``point_pair_features``  — PyG ``PointPairFeatures`` (:179-180): per-edge
+  (d, angle(n_s, d), angle(n_r, d), angle(n_s, n_r)) from node normals.
+* ``stratified_subsample`` — ``__stratified_sampling`` (:214-259): category =
+  sum of sorted per-type atom counts weighted by 100**index, then a
+  stratified draw of ``subsample_percentage``.
+
+All transforms mutate the ``GraphSample`` in place and return it (the
+chaining style of ``build_radius_graph``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graphs.graph import GraphSample
+
+
+def normalize_rotation(sample: GraphSample) -> GraphSample:
+    """Rotate positions into the principal-axis (PCA) frame: centered
+    positions times the right singular vectors, right-handed. Force targets,
+    being covariant vectors, rotate with the frame."""
+    pos = np.asarray(sample.pos, np.float64)
+    if pos.shape[0] < 2:
+        return sample
+    centered = pos - pos.mean(axis=0, keepdims=True)
+    _, _, vt = np.linalg.svd(centered, full_matrices=False)
+    rot = vt.T
+    if np.linalg.det(rot) < 0:  # keep chirality: proper rotation only
+        rot[:, -1] *= -1.0
+    sample.pos = (centered @ rot).astype(np.float32)
+    if sample.forces_y is not None and np.any(sample.forces_y):
+        sample.forces_y = (np.asarray(sample.forces_y, np.float64) @ rot).astype(
+            np.float32
+        )
+    if sample.num_edges and np.any(sample.edge_shifts):
+        sample.edge_shifts = (
+            np.asarray(sample.edge_shifts, np.float64) @ rot
+        ).astype(np.float32)
+    return sample
+
+
+def _edge_vectors(sample: GraphSample) -> np.ndarray:
+    pos = np.asarray(sample.pos)
+    return (
+        pos[sample.receivers] - pos[sample.senders] + np.asarray(sample.edge_shifts)
+    )
+
+
+def attach_edge_lengths(sample: GraphSample) -> GraphSample:
+    """Append the Euclidean edge length as an edge_attr column (PyG
+    ``Distance(norm=False, cat=True)``)."""
+    d = np.linalg.norm(_edge_vectors(sample), axis=1, keepdims=True).astype(np.float32)
+    ea = np.asarray(sample.edge_attr, np.float32)
+    if ea.size == 0:
+        ea = ea.reshape(sample.num_edges, 0)
+    sample.edge_attr = np.concatenate([ea, d], axis=1)
+    return sample
+
+
+def normalize_edge_lengths_global(samples, eps: float = 1e-12) -> float:
+    """Divide every sample's edge_attr by the GLOBAL max entry — across the
+    dataset and, when ``jax.distributed`` is live, across processes (the
+    reference's ``all_reduce(MAX)``, :157-173). Returns the max used."""
+    local_max = float("-inf")
+    for s in samples:
+        if s.edge_attr.size:
+            local_max = max(local_max, float(np.max(s.edge_attr)))
+    global_max = local_max
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            all_max = multihost_utils.process_allgather(
+                np.array([local_max], np.float32)
+            )
+            global_max = float(np.max(all_max))
+    except Exception:
+        pass
+    if not np.isfinite(global_max) or abs(global_max) < eps:
+        return 1.0
+    for s in samples:
+        if s.edge_attr.size:
+            s.edge_attr = (s.edge_attr / global_max).astype(np.float32)
+    return global_max
+
+
+def spherical_features(sample: GraphSample, norm: bool = True) -> GraphSample:
+    """Append per-edge spherical coordinates (rho, theta, phi) of the
+    relative position vector (PyG ``Spherical``); ``norm`` scales rho by its
+    max, theta by 2*pi and phi by pi, matching the PyG default."""
+    vec = _edge_vectors(sample)
+    rho = np.linalg.norm(vec, axis=1)
+    theta = np.arctan2(vec[:, 1], vec[:, 0])
+    theta = np.where(theta < 0, theta + 2 * np.pi, theta)
+    safe_rho = np.where(rho > 0, rho, 1.0)
+    phi = np.arccos(np.clip(vec[:, 2] / safe_rho, -1.0, 1.0))
+    if norm:
+        rho = rho / max(float(rho.max()) if rho.size else 1.0, 1e-12)
+        theta = theta / (2 * np.pi)
+        phi = phi / np.pi
+    sph = np.stack([rho, theta, phi], axis=1).astype(np.float32)
+    ea = np.asarray(sample.edge_attr, np.float32)
+    if ea.size == 0:
+        ea = ea.reshape(sample.num_edges, 0)
+    sample.edge_attr = np.concatenate([ea, sph], axis=1)
+    return sample
+
+
+def point_pair_features(sample: GraphSample) -> GraphSample:
+    """Append PyG ``PointPairFeatures``: for edge (s, r) with relative vector
+    d and node normals n_s, n_r — (|d|, angle(n_s, d), angle(n_r, d),
+    angle(n_s, n_r)). Normals come from ``extras['normal']``; atomic systems
+    without normals default to +z (the features then reduce to polar angles)."""
+    vec = _edge_vectors(sample)
+    n = sample.num_nodes
+    normal = np.asarray(
+        sample.extras.get("normal", np.tile([0.0, 0.0, 1.0], (n, 1))), np.float64
+    )
+    ns = normal[sample.senders]
+    nr = normal[sample.receivers]
+
+    def angle(a, b):
+        cross = np.linalg.norm(np.cross(a, b), axis=1)
+        dot = np.sum(a * b, axis=1)
+        return np.arctan2(cross, dot)
+
+    d = np.linalg.norm(vec, axis=1)
+    feats = np.stack([d, angle(ns, vec), angle(nr, vec), angle(ns, nr)], axis=1).astype(
+        np.float32
+    )
+    ea = np.asarray(sample.edge_attr, np.float32)
+    if ea.size == 0:
+        ea = ea.reshape(sample.num_edges, 0)
+    sample.edge_attr = np.concatenate([ea, feats], axis=1)
+    return sample
+
+
+def composition_category(sample: GraphSample, type_column: int = 0) -> int:
+    """The reference's stratification key (:237-247): sorted positive
+    per-type counts combined as sum(freq * 100**index)."""
+    types = np.asarray(sample.x[:, type_column]).astype(np.int64)
+    freq = np.bincount(types[types >= 0])
+    freq = sorted(int(f) for f in freq if f > 0)
+    return int(sum(f * (100**i) for i, f in enumerate(freq)))
+
+
+def stratified_subsample(
+    samples, percentage: float, seed: int = 0, type_column: int = 0
+):
+    """Stratified draw of ``percentage`` of the dataset, preserving the
+    composition-category distribution (the sklearn StratifiedShuffleSplit of
+    :249-259, re-implemented rng-deterministically without sklearn)."""
+    if not 0.0 < percentage <= 1.0:
+        raise ValueError(f"subsample_percentage must be in (0, 1], got {percentage}")
+    cats = np.array([composition_category(s, type_column) for s in samples])
+    rng = np.random.default_rng(seed)
+    picked: list[int] = []
+    for cat in np.unique(cats):
+        idx = np.flatnonzero(cats == cat)
+        k = max(1, int(round(len(idx) * percentage)))
+        picked.extend(rng.choice(idx, size=min(k, len(idx)), replace=False).tolist())
+    picked.sort()
+    return [samples[i] for i in picked]

@@ -1,0 +1,190 @@
+"""Preprocessing parity tests: rotation normalization, edge-length
+normalization, spherical / point-pair descriptors, stratified subsampling,
+atomic descriptor tables (reference serialized_dataset_loader.py:110-259 and
+descriptors_and_embeddings/atomicdescriptors.py)."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graphs.graph import GraphSample
+from hydragnn_tpu.graphs.radius import radius_graph
+from hydragnn_tpu.preprocess.descriptors import (
+    AtomicDescriptors,
+    attach_atomic_descriptors,
+    smiles_to_graph,
+    xyz2mol,
+)
+from hydragnn_tpu.preprocess.transforms import (
+    attach_edge_lengths,
+    composition_category,
+    normalize_edge_lengths_global,
+    normalize_rotation,
+    point_pair_features,
+    spherical_features,
+    stratified_subsample,
+)
+
+
+def make_sample(n=12, seed=0, types=(1.0, 2.0)):
+    rng = np.random.default_rng(seed)
+    pos = rng.uniform(0, 4.0, size=(n, 3))
+    s, r, sh = radius_graph(pos, radius=2.5, max_neighbours=12)
+    return GraphSample(
+        x=rng.choice(types, size=(n, 1)).astype(np.float32),
+        pos=pos,
+        senders=s,
+        receivers=r,
+        edge_shifts=sh,
+        graph_y=np.zeros(1),
+        node_y=np.zeros((n, 1)),
+        forces_y=rng.normal(size=(n, 3)).astype(np.float32),
+    )
+
+
+def test_normalize_rotation_invariants():
+    """PCA-frame rotation: pairwise distances preserved, result orientation
+    is canonical (a pre-rotated copy normalizes to the same frame)."""
+    s = make_sample(seed=1)
+    d_before = np.linalg.norm(s.pos[:, None] - s.pos[None, :], axis=-1)
+    f_norm_before = np.linalg.norm(s.forces_y)
+    normalize_rotation(s)
+    d_after = np.linalg.norm(s.pos[:, None] - s.pos[None, :], axis=-1)
+    np.testing.assert_allclose(d_before, d_after, atol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(s.forces_y), f_norm_before, rtol=1e-5)
+    assert float(np.abs(s.pos.mean(axis=0)).max()) < 1e-4  # centered
+
+    # rotating the input must not change the normalized output (up to sign)
+    s2 = make_sample(seed=1)
+    theta = 0.7
+    rot = np.array(
+        [
+            [np.cos(theta), -np.sin(theta), 0],
+            [np.sin(theta), np.cos(theta), 0],
+            [0, 0, 1],
+        ]
+    )
+    s2.pos = (s2.pos @ rot).astype(np.float32)
+    s2.forces_y = (s2.forces_y @ rot).astype(np.float32)
+    normalize_rotation(s2)
+    np.testing.assert_allclose(np.abs(s.pos), np.abs(s2.pos), atol=1e-4)
+
+
+def test_edge_length_normalization_global_max():
+    samples = [make_sample(seed=i) for i in range(3)]
+    for s in samples:
+        attach_edge_lengths(s)
+    raw_max = max(float(s.edge_attr.max()) for s in samples)
+    used = normalize_edge_lengths_global(samples)
+    assert used == pytest.approx(raw_max)
+    new_max = max(float(s.edge_attr.max()) for s in samples)
+    assert new_max == pytest.approx(1.0)
+    # lengths stay consistent with geometry after scaling
+    s = samples[0]
+    vec = s.pos[s.receivers] - s.pos[s.senders]
+    np.testing.assert_allclose(
+        s.edge_attr[:, -1], np.linalg.norm(vec, axis=1) / used, rtol=1e-5
+    )
+
+
+def test_spherical_features_ranges():
+    s = make_sample(seed=2)
+    cols_before = s.edge_attr.shape[1] if s.edge_attr.size else 0
+    spherical_features(s)
+    sph = s.edge_attr[:, cols_before:]
+    assert sph.shape[1] == 3
+    assert np.all(sph >= -1e-6) and np.all(sph <= 1.0 + 1e-6)  # PyG norm=True
+
+
+def test_point_pair_features_angles():
+    s = make_sample(seed=3)
+    cols_before = s.edge_attr.shape[1] if s.edge_attr.size else 0
+    point_pair_features(s)
+    ppf = s.edge_attr[:, cols_before:]
+    assert ppf.shape[1] == 4
+    assert np.all(ppf[:, 1:] >= 0) and np.all(ppf[:, 1:] <= np.pi + 1e-6)
+    # default +z normals: angle(n_s, n_r) must be exactly 0
+    np.testing.assert_allclose(ppf[:, 3], 0.0, atol=1e-6)
+
+
+def test_stratified_subsample_preserves_composition():
+    rng = np.random.default_rng(0)
+    samples = []
+    for i in range(200):
+        # two composition classes with an 80/20 imbalance
+        kinds = (1.0, 1.0, 2.0) if i % 5 else (2.0, 2.0, 2.0)
+        s = make_sample(n=6, seed=i, types=kinds)
+        samples.append(s)
+    cats = np.array([composition_category(s) for s in samples])
+    sub = stratified_subsample(samples, 0.25, seed=1)
+    sub_cats = np.array([composition_category(s) for s in sub])
+    assert len(sub) == pytest.approx(50, abs=10)
+    for c in np.unique(cats):
+        frac_full = float((cats == c).mean())
+        frac_sub = float((sub_cats == c).mean())
+        assert frac_sub == pytest.approx(frac_full, abs=0.1)
+
+
+def test_stratified_subsample_rejects_bad_percentage():
+    with pytest.raises(ValueError):
+        stratified_subsample([make_sample()], 0.0)
+
+
+def test_atomic_descriptors_table_and_onehot(tmp_path):
+    d = AtomicDescriptors(element_types=["C", "H", "O"])
+    for sym, z in (("H", 1), ("C", 6), ("O", 8)):
+        feats = d.get_atom_features(z)
+        assert len(feats) > 10
+        assert np.all(np.isfinite(feats))
+    # electronegativity ordering sanity: O > C > H (column after type one-hot,
+    # group, period, radius, EA, block-oh(2: s,p), volume, Z, mass -> index
+    # varies; check via known monotone property instead: mass column)
+    assert d.get_atom_features(8) != d.get_atom_features(6)
+
+    # one-hot variant + JSON cache round-trip (reference file contract)
+    path = str(tmp_path / "emb.json")
+    d2 = AtomicDescriptors(path, element_types=["C", "H", "O"], one_hot=True)
+    vals = np.array(d2.get_atom_features(6))
+    assert set(np.unique(vals)).issubset({0.0, 1.0})
+    d3 = AtomicDescriptors(path, overwritten=False)
+    assert d3.get_atom_features(6) == d2.get_atom_features(6)
+
+    with pytest.raises(ValueError):
+        AtomicDescriptors(element_types=["C", "Unobtainium"])
+
+
+def test_attach_atomic_descriptors_widens_x():
+    s = make_sample(seed=4, types=(1.0, 6.0))
+    d = AtomicDescriptors(element_types=None)  # full table
+    w = s.x.shape[1]
+    attach_atomic_descriptors(s, d)
+    assert s.x.shape[1] > w
+    assert np.all(np.isfinite(s.x))
+
+
+def test_rdkit_stubs_raise_with_guidance():
+    with pytest.raises((ImportError, NotImplementedError), match="rdkit"):
+        xyz2mol([6, 1], np.zeros((2, 3)))
+    with pytest.raises((ImportError, NotImplementedError), match="rdkit"):
+        smiles_to_graph("CCO")
+
+
+def test_pipeline_wiring_via_config():
+    """Dataset.rotational_invariance / Descriptors / subsample_percentage all
+    reachable from dataset_loading_and_splitting."""
+    import copy
+
+    from hydragnn_tpu.preprocess.load_data import dataset_loading_and_splitting
+    from test_config import CI_CONFIG
+
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["Dataset"]["rotational_invariance"] = True
+    cfg["Dataset"]["compute_edge_lengths"] = True
+    cfg["Dataset"]["Descriptors"] = {
+        "spherical_coordinates": True,
+        "point_pair_features": True,
+    }
+    samples = [make_sample(seed=i) for i in range(20)]
+    tr, va, te = dataset_loading_and_splitting(cfg, samples=samples)
+    b = next(iter(tr))
+    # 1 length + 3 spherical + 4 point-pair columns
+    assert b.edge_attr.shape[1] >= 8
