@@ -138,6 +138,17 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     else:
         arch.setdefault("max_graph_nodes", None)
 
+    # accepted-but-subsumed sections warn instead of silently vanishing
+    if nn.get("ds_config"):
+        import warnings
+
+        warnings.warn(
+            "NeuralNetwork.ds_config (DeepSpeed) is subsumed by XLA SPMD "
+            "sharding on TPU: ZeRO-1 optimizer sharding is automatic with "
+            "sharded params, and HYDRAGNN_USE_FSDP=1 gives ZeRO-3-style "
+            "parameter sharding. The ds_config section is ignored."
+        )
+
     # --- head normalization (reference :50-53) ---
     arch["output_heads"] = update_multibranch_heads(arch.get("output_heads", {}))
 

@@ -172,7 +172,9 @@ def make_mlip_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32
         (tot, (tasks, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, batch, dropout_rng
         )
-        grads = _cast_floats(grads, jnp.float32)
+        from ..train.step import freeze_conv_grads
+
+        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

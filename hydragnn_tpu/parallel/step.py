@@ -154,7 +154,9 @@ def make_parallel_train_step(
         (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.batch_stats, batches, dropout_rng)
-        grads = _cast_floats(grads, jnp.float32)
+        from ..train.step import freeze_conv_grads
+
+        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
@@ -297,7 +299,9 @@ def _make_parallel_mlip_train_step(
         (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.batch_stats, batches, dropout_rng)
-        grads = _cast_floats(grads, jnp.float32)
+        from ..train.step import freeze_conv_grads
+
+        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

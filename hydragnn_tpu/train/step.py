@@ -48,11 +48,49 @@ class TrainState(NamedTuple):
     step: jax.Array
 
 
+_FROZEN_PREFIXES = ("graph_convs_", "feature_norm_")
+
+
+def freeze_conv_grads(grads, spec) -> Any:
+    """``freeze_conv_layers``: zero gradients for the conv stack + feature
+    norms (the reference's ``requires_grad=False`` over ``graph_convs`` and
+    ``feature_layers``, ``Base.py:495-500``); heads keep training."""
+    if not getattr(spec, "freeze_conv_layers", False):
+        return grads
+    return {
+        k: (jax.tree.map(jnp.zeros_like, v) if k.startswith(_FROZEN_PREFIXES) else v)
+        for k, v in grads.items()
+    }
+
+
+def apply_initial_bias(params, spec):
+    """``initial_bias``: fill the last linear layer's bias of every
+    graph-type head (reference ``_set_bias``, ``Base.py:502-507`` — UQ
+    initialization for ensemble heads)."""
+    if getattr(spec, "initial_bias", None) is None:
+        return params
+    bias = float(spec.initial_bias)
+    for ihead, otype in enumerate(spec.output_type):
+        if otype != "graph":
+            continue
+        for key in params:
+            if not key.startswith(f"head{ihead}_"):
+                continue
+            dense_keys = sorted(
+                (k for k in params[key] if k.startswith("dense_")),
+                key=lambda k: int(k.split("_")[-1]),
+            )
+            if dense_keys and "bias" in params[key][dense_keys[-1]]:
+                leaf = params[key][dense_keys[-1]]["bias"]
+                params[key][dense_keys[-1]]["bias"] = jnp.full_like(leaf, bias)
+    return params
+
+
 def create_train_state(model: HydraModel, optimizer, example_batch, rng=None) -> TrainState:
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     example_batch = jax.tree.map(jnp.asarray, example_batch)
     variables = model.init(rng, example_batch, train=False)
-    params = variables["params"]
+    params = apply_initial_bias(variables["params"], model.spec)
     batch_stats = variables.get("batch_stats", {})
     opt_state = optimizer.init(params)
     return TrainState(
@@ -94,7 +132,7 @@ def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
         (tot, (tasks, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, batch, dropout_rng
         )
-        grads = _cast_floats(grads, jnp.float32)
+        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

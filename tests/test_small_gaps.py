@@ -1,0 +1,205 @@
+"""Config-knob and materials-workflow parity: freeze_conv_layers,
+initial_bias, ds_config warning, LSMS formation-Gibbs postprocess,
+energy linear regression."""
+
+import copy
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.datasets import deterministic_graph_data
+from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+from hydragnn_tpu.models import create_model_config
+from hydragnn_tpu.preprocess import apply_variables_of_interest
+from hydragnn_tpu.train import create_train_state, make_train_step, select_optimizer
+
+from test_config import CI_CONFIG
+
+
+def _build(arch_overrides: dict):
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"].update(arch_overrides)
+    samples = deterministic_graph_data(number_configurations=8, seed=21)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 8)
+    batch = jax.tree.map(jnp.asarray, collate(samples, pad))
+    optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    state = create_train_state(model, optimizer, batch)
+    return model, optimizer, state, batch
+
+
+def test_freeze_conv_layers_freezes_convs_only():
+    model, optimizer, state, batch = _build({"freeze_conv_layers": True})
+    step = make_train_step(model, optimizer)
+    new_state, _ = step(state, batch)
+    for key in state.params:
+        before = jax.tree.leaves(state.params[key])
+        after = jax.tree.leaves(new_state.params[key])
+        changed = any(
+            not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(before, after)
+        )
+        if key.startswith(("graph_convs_", "feature_norm_")):
+            assert not changed, f"frozen subtree {key} moved"
+        else:
+            assert changed, f"head subtree {key} did not train"
+
+
+def test_initial_bias_fills_graph_head_bias():
+    model, optimizer, state, batch = _build({"initial_bias": 7.5})
+    found = False
+    for key, sub in state.params.items():
+        if key.startswith("head0_"):
+            dense_keys = sorted(
+                (k for k in sub if k.startswith("dense_")),
+                key=lambda k: int(k.split("_")[-1]),
+            )
+            bias = np.asarray(sub[dense_keys[-1]]["bias"])
+            np.testing.assert_allclose(bias, 7.5)
+            found = True
+    assert found
+
+
+def test_ds_config_warns():
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["ds_config"] = {"zero_optimization": {"stage": 3}}
+    samples = deterministic_graph_data(number_configurations=4, seed=3)
+    samples = apply_variables_of_interest(samples, cfg)
+    with pytest.warns(UserWarning, match="DeepSpeed"):
+        update_config(cfg, samples)
+
+
+# ---------- LSMS formation Gibbs energy ----------
+
+
+def _write_lsms_dir(tmp_path, energies_and_types):
+    d = tmp_path / "lsms"
+    d.mkdir()
+    for i, (energy, types) in enumerate(energies_and_types):
+        rows = []
+        rng = np.random.default_rng(i)
+        for j, t in enumerate(types):
+            x, y, z = rng.uniform(0, 3, 3)
+            rows.append(f"{t}\t{j}\t{x:.5f}\t{y:.5f}\t{z:.5f}\t0.0")
+        (d / f"cfg{i}.txt").write_text(f"{energy}\n" + "\n".join(rows) + "\n")
+    return str(d)
+
+
+def test_formation_gibbs_conversion(tmp_path):
+    from hydragnn_tpu.postprocess.lsms import (
+        compute_formation_enthalpy,
+        convert_total_energy_to_formation_gibbs,
+    )
+
+    # pure A (Z=26), pure B (Z=78), and one mixed cell
+    d = _write_lsms_dir(
+        tmp_path,
+        [
+            (-4.0, [26, 26, 26, 26]),  # pure A: -1.0 / atom
+            (-8.0, [78, 78, 78, 78]),  # pure B: -2.0 / atom
+            (-6.5, [26, 26, 78, 78]),  # mixed: linear mix = -6.0
+        ],
+    )
+    new_dir = convert_total_energy_to_formation_gibbs(d, [26, 78], temperature_kelvin=0.0)
+    vals = {}
+    for name in sorted(os.listdir(new_dir)):
+        with open(os.path.join(new_dir, name)) as f:
+            vals[name] = float(f.readline().split()[0])
+    # pure cells: formation enthalpy 0; mixed: -6.5 - (-6.0) = -0.5
+    assert vals["cfg0.txt"] == pytest.approx(0.0, abs=1e-10)
+    assert vals["cfg1.txt"] == pytest.approx(0.0, abs=1e-10)
+    assert vals["cfg2.txt"] == pytest.approx(-0.5, abs=1e-8)
+
+    # entropy term lowers Gibbs at T>0 for the mixed cell only
+    comp, mix, dh, entropy = compute_formation_enthalpy(
+        np.array([26, 26, 78, 78]), -6.5, [26, 78], {26: -1.0, 78: -2.0}
+    )
+    assert comp == pytest.approx(0.5)
+    assert entropy > 0
+
+
+def test_compositional_histogram_cutoff(tmp_path):
+    from hydragnn_tpu.postprocess.lsms import compositional_histogram_cutoff
+
+    # six cells at composition 5/8 = 0.625 (bin 2 of 5) + one rare at 7/8
+    cells = [(-1.0, [26] * 5 + [78] * 3) for _ in range(6)] + [
+        (-1.0, [26] * 7 + [78] * 1)
+    ]
+    d = _write_lsms_dir(tmp_path, cells)
+    new_dir = compositional_histogram_cutoff(d, [26, 78], histogram_cutoff=3, num_bins=5)
+    kept = os.listdir(new_dir)
+    assert len(kept) < len(cells)  # the overfull 0.625 bin was capped
+    assert any("cfg6" in k for k in kept)  # the rare composition survives
+
+
+# ---------- energy linear regression ----------
+
+
+def test_energy_linear_regression_recovers_baseline(tmp_path):
+    from hydragnn_tpu.graphs.graph import GraphSample
+    from hydragnn_tpu.preprocess.energy_linear_regression import (
+        apply_energy_linear_regression,
+        fit_energy_linear_regression,
+    )
+
+    # energies are EXACTLY linear in composition: E = -1.5*n_C - 3.0*n_O
+    rng = np.random.default_rng(0)
+    ref = {6: -1.5, 8: -3.0}
+    samples = []
+    for i in range(40):
+        zs = rng.choice([6, 8], size=rng.integers(3, 9))
+        e = sum(ref[int(z)] for z in zs)
+        n = len(zs)
+        samples.append(
+            GraphSample(
+                x=zs.reshape(-1, 1).astype(np.float32),
+                pos=rng.uniform(0, 3, (n, 3)),
+                graph_y=np.array([e], np.float32),
+                node_y=np.zeros((n, 1), np.float32),
+                energy_y=np.array([e], np.float32),
+            )
+        )
+    coeff = fit_energy_linear_regression(samples)
+    assert coeff[5] == pytest.approx(-1.5, abs=1e-6)  # Z=6 -> bin index 5
+    assert coeff[7] == pytest.approx(-3.0, abs=1e-6)
+    apply_energy_linear_regression(samples, coeff)
+    for s in samples:
+        assert float(s.graph_y[0]) == pytest.approx(0.0, abs=1e-5)
+        assert float(s.energy_y[0]) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_energy_linear_regression_packed_driver(tmp_path):
+    from hydragnn_tpu.datasets.packed import PackedDataset, PackedWriter
+    from hydragnn_tpu.graphs.graph import GraphSample
+    from hydragnn_tpu.preprocess.energy_linear_regression import (
+        energy_linear_regression_packed,
+    )
+
+    rng = np.random.default_rng(1)
+    samples = []
+    for i in range(10):
+        n = int(rng.integers(3, 7))
+        zs = rng.choice([1, 6], size=n)
+        e = float(-0.5 * (zs == 1).sum() - 2.0 * (zs == 6).sum())
+        samples.append(
+            GraphSample(
+                x=zs.reshape(-1, 1).astype(np.float32),
+                pos=rng.uniform(0, 3, (n, 3)),
+                graph_y=np.array([e], np.float32),
+                node_y=np.zeros((n, 1), np.float32),
+                energy_y=np.array([e], np.float32),
+            )
+        )
+    src = str(tmp_path / "in.gpk")
+    dst = str(tmp_path / "out.gpk")
+    PackedWriter(samples, src)
+    coeff = energy_linear_regression_packed(src, dst)
+    out = PackedDataset(dst)
+    assert "energy_linear_regression_coeff" in out.attrs
+    for i in range(len(out)):
+        assert float(out[i].graph_y[0]) == pytest.approx(0.0, abs=1e-4)
