@@ -40,8 +40,8 @@ def main():
     from hydragnn_tpu.preprocess import apply_variables_of_interest
     from hydragnn_tpu.train import create_train_state, select_optimizer
     from hydragnn_tpu.train.multibranch import (
+        branch_device_batches,
         concat_multidataset,
-        interleave_branch_batches,
         make_branch_loaders,
     )
 
@@ -87,7 +87,7 @@ def main():
             },
             "Training": {
                 "num_epoch": args.epochs,
-                "batch_size": 8,
+                "batch_size": 4,
                 "loss_function_type": "mse",
                 "Optimizer": {"type": "AdamW", "learning_rate": 0.005},
             },
@@ -121,11 +121,9 @@ def main():
 
     for epoch in range(args.epochs):
         losses = []
-        for step_batches in interleave_branch_batches(loaders, epoch):
-            per_dev = []
-            for bb in step_batches:
-                per_dev.extend([bb] * n_data)
-            sb = put_batch(stack_device_batches(per_dev[: n_branch * n_data]), mesh)
+        # each device in a branch row gets its own batch (distinct data)
+        for step_batches in branch_device_batches(loaders, epoch, n_data):
+            sb = put_batch(stack_device_batches(step_batches), mesh)
             state, metrics = train_step(state, sb)
             losses.append(float(metrics["loss"]))
         print(f"epoch {epoch}: loss {np.mean(losses):.6f}")

@@ -1,0 +1,171 @@
+"""GFM-scale multidataset pretraining driver (reference
+``examples/multidataset/train.py`` + the SC25 weak-scaling recipe,
+``run-scripts/SC25-job-weak.sh``): N packed-record datasets -> one shared
+encoder with per-dataset decoder branches over a (branch, data) mesh, with
+oversampling to equalize branch step counts and branch-axis decoder sharding.
+
+    # synthesize per-branch packed stores, then train from them
+    python examples/multidataset/train.py --make-synthetic /tmp/gfm --branches 2
+    python examples/multidataset/train.py --multi /tmp/gfm/branch0.gpk,/tmp/gfm/branch1.gpk
+
+Env knobs (reference parity): HYDRAGNN_MAX_NUM_BATCH caps steps/epoch (the
+SC25 scripts pin 5 fixed batches/epoch), HYDRAGNN_VALTEST=0 skips eval.
+
+CPU dry run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def make_synthetic(outdir: str, branches: int, configs: int) -> list[str]:
+    """Zero-egress fallback: one packed store per branch with branch-scaled
+    targets (stands in for ANI1x/qm7x/MPTrj/... downloads)."""
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.datasets.packed import PackedWriter
+
+    os.makedirs(outdir, exist_ok=True)
+    paths = []
+    for b in range(branches):
+        ds = deterministic_graph_data(
+            number_configurations=max(4, configs // (b + 1)), seed=100 + b
+        )
+        for s in ds:
+            s.graph_y = (1.0 + b) * s.graph_y
+            s.dataset_id = b
+        path = os.path.join(outdir, f"branch{b}.gpk")
+        PackedWriter(ds, path, attrs={"dataset_name": f"synthetic-branch{b}"})
+        paths.append(path)
+    return paths
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--multi", type=str, default=None,
+        help="comma-separated packed dataset paths, one per branch",
+    )
+    ap.add_argument("--make-synthetic", type=str, default=None, metavar="DIR")
+    ap.add_argument("--branches", type=int, default=2)
+    ap.add_argument("--configs", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.datasets.packed import GlobalShuffleStore
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.parallel import (
+        make_mesh,
+        make_parallel_train_step,
+        put_batch,
+        shard_state,
+        stack_device_batches,
+    )
+    from hydragnn_tpu.preprocess import apply_variables_of_interest
+    from hydragnn_tpu.train import create_train_state, select_optimizer
+    from hydragnn_tpu.train.multibranch import (
+        branch_device_batches,
+        make_branch_loaders,
+    )
+
+    if args.multi is None:
+        outdir = args.make_synthetic or "./multidataset_synthetic"
+        paths = make_synthetic(outdir, args.branches, args.configs)
+        print(f"synthesized {len(paths)} packed stores under {outdir}")
+    else:
+        paths = [p for p in args.multi.split(",") if p]
+
+    n_branch = len(paths)
+    n_dev = len(jax.devices())
+    n_data = max(1, n_dev // n_branch)
+    print(f"mesh: ({n_branch} branch x {n_data} data) over {n_dev} devices")
+
+    branch_arch = {
+        "num_sharedlayers": 1,
+        "dim_sharedlayers": 16,
+        "num_headlayers": 2,
+        "dim_headlayers": [32, 32],
+    }
+    config = {
+        "Verbosity": {"level": 1},
+        "Dataset": {
+            "name": "multidataset_gfm",
+            "format": "packed",
+            "node_features": {"name": ["type", "x", "x2", "x3"], "dim": [1, 1, 1, 1],
+                               "column_index": [0, 1, 2, 3]},
+            "graph_features": {"name": ["sum"], "dim": [1], "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "radius": 2.0,
+                "hidden_dim": 32,
+                "num_conv_layers": 3,
+                "output_heads": {
+                    "graph": [
+                        {"type": f"branch-{i}", "architecture": dict(branch_arch)}
+                        for i in range(n_branch)
+                    ]
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "num_epoch": args.epochs,
+                "batch_size": args.batch,
+                "loss_function_type": "mse",
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.005},
+            },
+        },
+    }
+
+    # lazy packed stores; dataset_id tags were written per branch
+    datasets = {}
+    for b, path in enumerate(paths):
+        store = GlobalShuffleStore(path)
+        samples = store.ds.load_all()  # branch datasets are modest per host
+        samples = apply_variables_of_interest(samples, config)
+        for s in samples:
+            s.dataset_id = b
+        name = store.attrs.get("dataset_name", f"branch-{b}")
+        datasets[name] = samples
+        print(f"branch {b}: {name}, {len(samples)} samples")
+
+    allsamples = [s for ds in datasets.values() for s in ds]
+    config = update_config(config, allsamples)
+    model = create_model_config(config)
+    opt = select_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+
+    loaders, pad = make_branch_loaders(datasets, batch_size=args.batch)
+    mesh = make_mesh(n_branch=n_branch, n_data=n_data)
+
+    first = next(iter(loaders[0]))
+    state = create_train_state(model, opt, first)
+    state = shard_state(state, mesh, param_mode="branch")
+    train_step = make_parallel_train_step(model, opt, mesh)
+
+    max_batch = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
+    for epoch in range(args.epochs):
+        losses = []
+        for ib, step_batches in enumerate(branch_device_batches(loaders, epoch, n_data)):
+            if max_batch is not None and ib >= int(max_batch):
+                break
+            sb = put_batch(stack_device_batches(step_batches), mesh)
+            state, metrics = train_step(state, sb)
+            losses.append(float(metrics["loss"]))
+        print(f"epoch {epoch}: loss {np.mean(losses):.6f} over {len(losses)} steps")
+
+
+if __name__ == "__main__":
+    main()

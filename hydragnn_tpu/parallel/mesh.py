@@ -57,6 +57,37 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def branch_param_specs(params, mesh: Mesh, min_size_to_shard: int = 0):
+    """Multibranch decoder placement: branch decoder params (subtree keys
+    carrying ``_branch-``) shard their largest divisible axis over the
+    ``branch`` axis, so each device holds 1/n_branch of every decoder —
+    total decoder memory per device equals ONE branch's decoders, the same
+    footprint the reference gets by pinning a branch's decoder to its branch
+    process group (``MultiTaskModelMP.py:269-490``). XLA all-gathers a
+    decoder over the branch ring right before its (tiny) head matmul —
+    ZeRO-3 scheduling on the branch axis. The shared encoder stays
+    replicated."""
+    n_branch = mesh.shape[BRANCH_AXIS]
+
+    def spec_for_leaf(x):
+        if n_branch == 1 or x.ndim == 0 or x.size < max(min_size_to_shard, n_branch):
+            return P()
+        for i in sorted(range(x.ndim), key=lambda i: -x.shape[i]):
+            if x.shape[i] % n_branch == 0:
+                spec = [None] * x.ndim
+                spec[i] = BRANCH_AXIS
+                return P(*spec)
+        return P()
+
+    out = {}
+    for key, sub in params.items():
+        if "_branch-" in key:
+            out[key] = jax.tree.map(spec_for_leaf, sub)
+        else:
+            out[key] = jax.tree.map(lambda _: P(), sub)
+    return out
+
+
 def fsdp_param_specs(params, mesh: Mesh, min_size_to_shard: int = 2**14):
     """ZeRO-3-style parameter sharding: biggest divisible axis -> data axis."""
     n_data = mesh.shape[DATA_AXIS]

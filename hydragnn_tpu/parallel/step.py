@@ -58,10 +58,16 @@ def _place(x, mesh: Mesh, spec: P):
 
 
 def shard_state(state: TrainState, mesh: Mesh, param_mode: str = "replicated") -> TrainState:
-    """Place a TrainState on the mesh (replicated or FSDP-sharded params;
-    optimizer state follows the param sharding — ZeRO-1 for free)."""
+    """Place a TrainState on the mesh. ``param_mode``: 'replicated' (DDP),
+    'fsdp' (ZeRO-3 over data), or 'branch' (multibranch decoders sharded over
+    the branch axis, encoder replicated). Optimizer state follows the param
+    sharding — ZeRO-1 for free."""
     if param_mode == "fsdp":
         pspecs = fsdp_param_specs(state.params, mesh)
+    elif param_mode == "branch":
+        from .mesh import branch_param_specs
+
+        pspecs = branch_param_specs(state.params, mesh)
     else:
         pspecs = jax.tree.map(lambda _: P(), state.params)
 

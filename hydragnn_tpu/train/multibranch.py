@@ -105,3 +105,19 @@ def interleave_branch_batches(loaders: list[GraphLoader], epoch: int):
     n_steps = min(len(ld) for ld in loaders)
     for _ in range(n_steps):
         yield [next(it) for it in iters]
+
+
+def branch_device_batches(loaders: list[GraphLoader], epoch: int, n_data: int):
+    """Yield per-step row-major device batch lists for a (branch, data) mesh:
+    each mesh step consumes ``n_data`` DISTINCT batches per branch, so every
+    device in a branch row trains on its own data (the reference's per-rank
+    DataLoader within each branch process group)."""
+    for ld in loaders:
+        ld.set_epoch(epoch)
+    iters = [iter(ld) for ld in loaders]
+    n_steps = min(len(ld) for ld in loaders) // n_data
+    for _ in range(n_steps):
+        step = []
+        for it in iters:
+            step.extend(next(it) for _ in range(n_data))
+        yield step

@@ -56,3 +56,22 @@ def test_example_multibranch():
     )
     assert "mesh: (2 branch x 4 data)" in out
     assert "epoch 1" in out
+
+
+def test_example_multidataset_packed(tmp_path):
+    """GFM-style driver: synthesize per-branch packed stores, then train
+    from them with --multi (the open_*/mptrj driver pattern)."""
+    d = str(tmp_path / "gfm")
+    out = run_example(
+        ["examples/multidataset/train.py", "--make-synthetic", d, "--branches", "2",
+         "--configs", "16", "--epochs", "2"]
+    )
+    assert "synthesized 2 packed stores" in out
+    assert "epoch 1" in out
+
+    out2 = run_example(
+        ["examples/multidataset/train.py", "--multi", f"{d}/branch0.gpk,{d}/branch1.gpk",
+         "--epochs", "1"]
+    )
+    assert "mesh: (2 branch x 4 data)" in out2
+    assert "epoch 0" in out2

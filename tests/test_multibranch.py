@@ -104,24 +104,46 @@ def test_multibranch_training_on_branch_data_mesh():
 
     loaders, pad = make_branch_loaders({"bcc": d0, "scaled": d1}, batch_size=2)
     mesh = make_mesh(n_branch=2, n_data=4)
-    steps = list(interleave_branch_batches(loaders, epoch=0))
 
-    # stack: mesh row-major device order = [b0d0 b0d1 b0d2 b0d3 b1d0 ...]
-    def stacked_for(step_batches):
-        per_dev = []
-        for b_idx, branch_batch in enumerate(step_batches):
-            # split the branch batch into 4 device microbatches by re-batching
-            per_dev.extend([branch_batch] * 4)
-        return stack_device_batches(per_dev[:8])
+    from hydragnn_tpu.train.multibranch import branch_device_batches
+
+    steps = list(branch_device_batches(loaders, 0, n_data=4))
+    # every device in a branch row sees DISTINCT data within the step
+    first = steps[0]
+    assert len(first) == 8
+    row0 = [np.asarray(b.x) for b in first[:4]]
+    assert not all(np.array_equal(row0[0], r) for r in row0[1:])
+    # row-major layout: first 4 are branch 0's data, last 4 branch 1's
+    for d in range(4):
+        assert set(
+            np.asarray(first[d].dataset_id)[np.asarray(first[d].graph_mask) > 0]
+        ) == {0}
+        assert set(
+            np.asarray(first[4 + d].dataset_id)[np.asarray(first[4 + d].graph_mask) > 0]
+        ) == {1}
 
     state = create_train_state(model, opt, steps[0][0])
-    state = shard_state(state, mesh)
+    # branch mode: decoders shard over the branch axis, encoder replicated
+    state = shard_state(state, mesh, param_mode="branch")
+    from jax.sharding import PartitionSpec as P
+
+    dec_specs = {
+        leaf.sharding.spec
+        for leaf in jax.tree.leaves(state.params["head0_branch-0"])
+        if leaf.ndim > 0
+    }
+    assert any("branch" in str(s) for s in dec_specs), dec_specs
+    enc_specs = {
+        leaf.sharding.spec for leaf in jax.tree.leaves(state.params["graph_convs_0"])
+    }
+    assert enc_specs == {P()}, enc_specs
+
     train_step = make_parallel_train_step(model, opt, mesh)
 
     losses = []
     for epoch in range(3):
-        for step_batches in interleave_branch_batches(loaders, epoch):
-            sb = put_batch(stacked_for(step_batches), mesh)
+        for step_batches in branch_device_batches(loaders, epoch, n_data=4):
+            sb = put_batch(stack_device_batches(step_batches), mesh)
             state, metrics = train_step(state, sb)
             losses.append(float(metrics["loss"]))
     assert np.isfinite(losses[-1])
@@ -131,5 +153,5 @@ def test_multibranch_training_on_branch_data_mesh():
     p = state.params
     h0 = jax.tree.leaves(p["head0_branch-0"])
     h1 = jax.tree.leaves(p["head0_branch-1"])
-    diff = max(float(jnp.abs(a - b).max()) for a, b in zip(h0, h1))
+    diff = max(float(jnp.abs(np.asarray(a) - np.asarray(b)).max()) for a, b in zip(h0, h1))
     assert diff > 1e-4, "branch decoders did not specialize"
