@@ -105,10 +105,15 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
 
         n_dev = len(jax.devices())  # global (all processes)
         n_local = len(jax.local_devices())
+        # edge-sharded (long-context) mode feeds ONE batch to the whole mesh,
+        # so any loader length works
+        edge_mode = bool(
+            config["NeuralNetwork"].get("Architecture", {}).get("edge_sharding")
+        )
         if (
             os.getenv("HYDRAGNN_AUTO_PARALLEL", "1") != "0"
             and n_dev > 1
-            and len(train_loader) >= n_local
+            and (edge_mode or len(train_loader) >= n_local)
         ):
             from .parallel import make_mesh, shard_state
 

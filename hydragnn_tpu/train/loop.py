@@ -76,27 +76,34 @@ def _local_device_count(mesh) -> int:
     return len(mesh.local_devices)
 
 
-def train_epoch(train_step, state: TrainState, loader, verbosity: int = 0, mesh=None):
-    """One training epoch; returns (state, mean loss, per-task mean losses)."""
+def train_epoch(
+    train_step, state: TrainState, loader, verbosity: int = 0, mesh=None, put_fn=None
+):
+    """One training epoch; returns (state, mean loss, per-task mean losses).
+    ``put_fn`` (edge-sharded mode) transfers each batch itself — no device
+    grouping; every step consumes ONE batch sharded across the mesh."""
     tot = 0.0
     tasks = None
     n_graphs = 0.0
     nbatch = _max_num_batches(loader)
-    n_dev = _local_device_count(mesh) if mesh is not None else 1
-    if mesh is not None:
+    grouped = mesh is not None and put_fn is None
+    n_dev = _local_device_count(mesh) if grouped else 1
+    if grouped:
         # the HYDRAGNN_MAX_NUM_BATCH cap counts raw loader batches; each
         # grouped step consumes n_dev of them
         nbatch = max(1, -(-nbatch // n_dev))
     it = (
         _grouped(loader, n_dev, mesh)
-        if mesh is not None
+        if grouped
         else iterate_tqdm(loader, verbosity, desc="train", total=nbatch)
     )
     tr.start("train")
     for ib, batch in enumerate(it):
         if ib >= nbatch:
             break
-        if mesh is None:
+        if put_fn is not None:
+            batch = put_fn(batch)
+        elif mesh is None:
             batch = jax.tree.map(jnp.asarray, batch)
         state, metrics = train_step(state, batch)
         # loss accumulated weighted by real graph count (reference :795-799)
@@ -112,7 +119,7 @@ def train_epoch(train_step, state: TrainState, loader, verbosity: int = 0, mesh=
 
 def evaluate(
     eval_step, state: TrainState, loader, verbosity: int = 0, span: str = "validate",
-    mesh=None,
+    mesh=None, put_fn=None,
 ):
     """Full-split evaluation; returns (loss, per-task losses, per-head rmse)."""
     tot = 0.0
@@ -120,15 +127,18 @@ def evaluate(
     sse = None
     count = None
     n_graphs = 0.0
-    n_dev = _local_device_count(mesh) if mesh is not None else 1
+    grouped = mesh is not None and put_fn is None
+    n_dev = _local_device_count(mesh) if grouped else 1
     it = (
         _grouped(loader, n_dev, mesh, fill=True)
-        if mesh is not None
+        if grouped
         else iterate_tqdm(loader, verbosity, desc=span, total=len(loader))
     )
     tr.start(span)
     for batch in it:
-        if mesh is None:
+        if put_fn is not None:
+            batch = put_fn(batch)
+        elif mesh is None:
             batch = jax.tree.map(jnp.asarray, batch)
         metrics = eval_step(state, batch)
         g = float(metrics["num_graphs"])
@@ -175,8 +185,26 @@ def train_validate_test(
     training = config_nn["Training"]
     num_epoch = int(training["num_epoch"])
     precision = resolve_precision(training.get("precision", "fp32"))
+    edge_sharded = bool(config_nn.get("Architecture", {}).get("edge_sharding"))
 
-    if mesh is not None:
+    put_fn = None
+    if mesh is not None and edge_sharded:
+        # long-context mode: every batch's EDGE arrays shard across the mesh,
+        # nodes replicated; one (possibly giant) batch per step
+        from functools import partial as _partial
+
+        from ..parallel.large_graph import (
+            make_edge_sharded_eval_step,
+            make_edge_sharded_train_step,
+            put_large_batch,
+        )
+
+        train_step = make_edge_sharded_train_step(
+            model, optimizer, mesh, compute_dtype=precision
+        )
+        eval_step = make_edge_sharded_eval_step(model, mesh, compute_dtype=precision)
+        put_fn = _partial(put_large_batch, mesh=mesh)
+    elif mesh is not None:
         from ..parallel.step import make_parallel_eval_step, make_parallel_train_step
 
         train_step = make_parallel_train_step(
@@ -220,7 +248,7 @@ def train_validate_test(
     for epoch in range(num_epoch):
         train_loader.set_epoch(epoch)
         state, train_loss, train_tasks = train_epoch(
-            train_step, state, train_loader, verbosity, mesh=mesh
+            train_step, state, train_loader, verbosity, mesh=mesh, put_fn=put_fn
         )
 
         if skip_valtest:
@@ -239,10 +267,12 @@ def train_validate_test(
             continue
 
         val_loss, val_tasks, _ = evaluate(
-            eval_step, state, val_loader, verbosity, "validate", mesh=mesh
+            eval_step, state, val_loader, verbosity, "validate", mesh=mesh,
+            put_fn=put_fn,
         )
         test_loss, test_tasks, test_rmse = evaluate(
-            eval_step, state, test_loader, verbosity, "test", mesh=mesh
+            eval_step, state, test_loader, verbosity, "test", mesh=mesh,
+            put_fn=put_fn,
         )
 
         new_lr = scheduler.step(val_loss)
