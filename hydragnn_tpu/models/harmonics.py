@@ -71,8 +71,57 @@ def _sh_blocks(x, y, z, l_max: int, xp):
             )
         )
     if l_max >= 4:
-        raise NotImplementedError("spherical harmonics implemented up to l=3")
+        out.extend(_sh_recurrence(x, y, z, 4, l_max, xp))
     return out
+
+
+def _sh_recurrence(x, y, z, l_from: int, l_max: int, xp):
+    """General real spherical harmonics for l >= 4 by recurrence, same
+    convention as the explicit blocks (m ordered -l..l, e3nn axis roles,
+    component normalization ||Y_l||^2 = 2l+1 on the unit sphere).
+
+    Uses A_m = Re (x+iy)^m, B_m = Im (x+iy)^m and associated Legendre
+    polynomials with the sin^m(theta) factor divided out (it lives in
+    A_m/B_m), so everything is polynomial in (x, y, z) — differentiable and
+    pole-safe."""
+    one = xp.ones_like(x)
+    A = [one, x]
+    B = [xp.zeros_like(x), y]
+    for m in range(2, l_max + 1):
+        A.append(x * A[m - 1] - y * B[m - 1])
+        B.append(x * B[m - 1] + y * A[m - 1])
+
+    # Q[(l, m)]: P_l^m(z) / sin^m(theta), via the standard l-recurrence
+    Q = {}
+    for m in range(l_max + 1):
+        Q[(m, m)] = float(math.prod(range(1, 2 * m, 2))) * one  # (2m-1)!!
+        if m + 1 <= l_max:
+            Q[(m + 1, m)] = (2 * m + 1) * z * Q[(m, m)]
+        for l in range(m + 2, l_max + 1):
+            Q[(l, m)] = (
+                (2 * l - 1) * z * Q[(l - 1, m)] - (l - 1 + m) * Q[(l - 2, m)]
+            ) / (l - m)
+
+    blocks = []
+    for l in range(l_from, l_max + 1):
+        comps = []
+        for m in range(-l, l + 1):
+            am = abs(m)
+            c = math.sqrt(
+                (2 * l + 1)
+                * (2.0 if m != 0 else 1.0)
+                * math.factorial(l - am)
+                / math.factorial(l + am)
+            )
+            base = c * Q[(l, am)]
+            if m < 0:
+                comps.append(base * B[am])
+            elif m > 0:
+                comps.append(base * A[am])
+            else:
+                comps.append(base)
+        blocks.append(xp.stack(comps, axis=-1))
+    return blocks
 
 
 def spherical_harmonics(vec: jax.Array, l_max: int, eps: float = 1e-6) -> list:

@@ -1,0 +1,108 @@
+"""Spherical harmonics + Gaunt coupling beyond the hand-written l<=3 blocks
+(the e3nn-arbitrary-irreps capability of the reference's mace_utils)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.models.harmonics import (
+    _sh_blocks,
+    _sh_recurrence,
+    coupling_paths,
+    gaunt_array,
+    spherical_harmonics,
+)
+
+
+def unit_vectors(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    v = rng.normal(size=(n, 3))
+    return v / np.linalg.norm(v, axis=1, keepdims=True)
+
+
+def test_recurrence_reproduces_explicit_blocks():
+    """The general recurrence and the hand-written l<=3 formulas must agree
+    exactly (same normalization + ordering convention)."""
+    v = unit_vectors()
+    x, y, z = v[:, 0], v[:, 1], v[:, 2]
+    explicit = _sh_blocks(x, y, z, 3, np)
+    recur = _sh_recurrence(x, y, z, 0, 3, np)
+    for l, (a, b) in enumerate(zip(explicit, recur)):
+        np.testing.assert_allclose(a, b, atol=1e-12, err_msg=f"l={l}")
+
+
+@pytest.mark.parametrize("l", [4, 5, 6])
+def test_high_l_component_normalization(l):
+    """Sum_m Y_lm(r)^2 == 2l+1 pointwise on the unit sphere."""
+    v = unit_vectors(seed=l)
+    Y = spherical_harmonics(np.asarray(v), l)[l]
+    np.testing.assert_allclose(
+        np.sum(np.asarray(Y) ** 2, axis=-1), 2 * l + 1, rtol=1e-5
+    )
+
+
+def test_high_l_orthogonality():
+    """Monte-Carlo Gram matrix over l=0..5: (1/4pi) ∫ Y_a Y_b = delta_ab in
+    the component basis — checked with exact quadrature."""
+    from hydragnn_tpu.models.harmonics import _quadrature
+
+    x, y, z, w = _quadrature(10)
+    blocks = _sh_blocks(x, y, z, 5, np)
+    Y = np.concatenate(blocks, axis=-1)  # [Q, sum(2l+1)]
+    gram = np.einsum("q,qa,qb->ab", w / (4 * np.pi), Y, Y)
+    np.testing.assert_allclose(gram, np.eye(Y.shape[1]), atol=1e-10)
+
+
+def test_high_l_rotation_equivariance():
+    """A rotation permutes within each l-block through the Wigner matrix:
+    ||Y_l(Rv)|| == ||Y_l(v)|| and scalar invariants are preserved."""
+    rng = np.random.default_rng(3)
+    theta = 0.83
+    R = np.array(
+        [
+            [np.cos(theta), -np.sin(theta), 0],
+            [np.sin(theta), np.cos(theta), 0],
+            [0, 0, 1],
+        ]
+    )
+    v = unit_vectors(64, seed=4)
+    for l in (4, 5):
+        Y = np.asarray(spherical_harmonics(v, l)[l])
+        YR = np.asarray(spherical_harmonics(v @ R.T, l)[l])
+        np.testing.assert_allclose(
+            np.sum(Y**2, axis=-1), np.sum(YR**2, axis=-1), rtol=1e-5
+        )
+    # pairwise scalar products are rotation invariant
+    Y4 = np.asarray(spherical_harmonics(v, 4)[4])
+    Y4R = np.asarray(spherical_harmonics(v @ R.T, 4)[4])
+    np.testing.assert_allclose(Y4 @ Y4.T, Y4R @ Y4R.T, rtol=1e-4, atol=1e-6)
+
+
+def test_gaunt_selection_rules_high_l():
+    """Gaunt coefficients vanish outside |l1-l2|<=l3<=l1+l2 and odd parity —
+    now including l > 3 couplings."""
+    G = gaunt_array(4, 2, 2)  # allowed: parity even, triangle ok
+    assert np.abs(G).max() > 0
+    G_parity = gaunt_array(4, 2, 3)  # l1+l2+l3 odd -> all zero
+    assert np.abs(G_parity).max() == 0
+    G_triangle = gaunt_array(4, 1, 2)  # 2 < |4-1| -> all zero
+    assert np.abs(G_triangle).max() == 0
+    paths = coupling_paths(4, 4, 5)
+    assert (4, 4, 4) in paths and (4, 1, 5) in paths
+
+
+def test_gaunt_l0_coupling_is_identity():
+    """Coupling with l=0 must be the (scaled) identity within a block."""
+    for l in (4, 5):
+        G = gaunt_array(0, l, l)[0]  # [2l+1, 2l+1]
+        np.testing.assert_allclose(G, np.eye(2 * l + 1), atol=1e-10)
+
+
+def test_padding_vectors_stay_finite_high_l():
+    v = np.zeros((4, 3), np.float32)
+    import jax.numpy as jnp
+
+    Y = spherical_harmonics(jnp.asarray(v), 5)
+    for block in Y:
+        assert np.all(np.isfinite(np.asarray(block)))
