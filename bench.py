@@ -353,6 +353,28 @@ def main():
         except Exception:
             errors[name] = traceback.format_exc(limit=5)
 
+    # A/B the Pallas fused-scatter kernel on the gin workload (default state
+    # restored afterwards); speedup > 1 means the kernel wins on this chip
+    if "gin" in workloads and os.getenv("BENCH_FUSED_AB", "1") != "0":
+        prev_flag = os.environ.get("HYDRAGNN_FUSED_SCATTER")
+        try:
+            os.environ["HYDRAGNN_FUSED_SCATTER"] = "0"
+            off = bench_gin(batch_size, max(bench_steps // 2, 5), warmup)
+            os.environ["HYDRAGNN_FUSED_SCATTER"] = "1"
+            on = bench_gin(batch_size, max(bench_steps // 2, 5), warmup)
+            workloads["gin"]["fused_scatter_speedup"] = round(
+                off["step_ms"] / on["step_ms"], 4
+            )
+            workloads["gin"]["step_ms_fused_off"] = off["step_ms"]
+            workloads["gin"]["step_ms_fused_on"] = on["step_ms"]
+        except Exception:
+            errors["fused_ab"] = traceback.format_exc(limit=3)
+        finally:
+            if prev_flag is None:
+                os.environ.pop("HYDRAGNN_FUSED_SCATTER", None)
+            else:
+                os.environ["HYDRAGNN_FUSED_SCATTER"] = prev_flag
+
     if "gin" in workloads:
         record["value"] = workloads["gin"]["graphs_per_sec_per_chip"]
         prev = _prev_value()
