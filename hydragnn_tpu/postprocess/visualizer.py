@@ -85,3 +85,127 @@ class Visualizer:
         fig.savefig(out, dpi=120, bbox_inches="tight")
         plt.close(fig)
         return out
+
+    def create_parity_plot_vector(
+        self, true_values, predicted_values, name: str = "vector",
+        component_names=None, filename: str | None = None,
+    ) -> str:
+        """Per-component parity grid for a vector head (reference
+        ``create_parity_plot_vector``, visualizer.py:467) — e.g. forces
+        [N, 3] as three parity panels."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        t = np.asarray(true_values).reshape(len(true_values), -1)
+        p = np.asarray(predicted_values).reshape(len(predicted_values), -1)
+        d = t.shape[1]
+        fig, axes = plt.subplots(1, d, figsize=(4 * d, 4), squeeze=False)
+        for c in range(d):
+            ax = axes[0][c]
+            ax.scatter(t[:, c], p[:, c], s=4, alpha=0.5)
+            lo = min(t[:, c].min(), p[:, c].min())
+            hi = max(t[:, c].max(), p[:, c].max())
+            ax.plot([lo, hi], [lo, hi], "k--", lw=1)
+            rmse = float(np.sqrt(np.mean((t[:, c] - p[:, c]) ** 2)))
+            cname = (
+                component_names[c]
+                if component_names and c < len(component_names)
+                else f"{name}[{c}]"
+            )
+            ax.set_title(f"{cname} (RMSE {rmse:.3g})")
+            ax.set_xlabel("true")
+            ax.set_ylabel("predicted")
+        out = os.path.join(self.dir, filename or f"parity_{name}.png")
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out
+
+    def create_density_parity_plot(
+        self, true_values, predicted_values, name: str = "head0",
+        filename: str | None = None, bins: int = 60,
+    ) -> str:
+        """Density parity (2D histogram) with a conditional-mean-error curve
+        (the reference's ``__hist2d_contour`` + ``__err_condmean`` pair,
+        visualizer.py:83-105) — readable at GFM sample counts where scatter
+        saturates."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        t = np.asarray(true_values).ravel()
+        p = np.asarray(predicted_values).ravel()
+        fig, (ax0, ax1) = plt.subplots(1, 2, figsize=(9, 4))
+        ax0.hexbin(t, p, gridsize=bins, mincnt=1, bins="log")
+        lo, hi = min(t.min(), p.min()), max(t.max(), p.max())
+        ax0.plot([lo, hi], [lo, hi], "k--", lw=1)
+        ax0.set_xlabel("true")
+        ax0.set_ylabel("predicted")
+        ax0.set_title(f"{name} density parity")
+        # conditional mean |error| in equal-count bins of the true value
+        order = np.argsort(t)
+        nb = max(min(bins // 3, len(t) // 10), 1)
+        splits = np.array_split(order, nb)
+        centers = [float(np.mean(t[s])) for s in splits if len(s)]
+        cond = [float(np.mean(np.abs(p[s] - t[s]))) for s in splits if len(s)]
+        ax1.plot(centers, cond, "o-")
+        ax1.set_xlabel("true value")
+        ax1.set_ylabel("mean |error|")
+        ax1.set_title("conditional mean error")
+        out = os.path.join(self.dir, filename or f"density_parity_{name}.png")
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out
+
+    def create_error_histogram_per_node(
+        self, true_values, predicted_values, node_counts,
+        filename: str = "error_per_node.png",
+    ) -> str:
+        """Node-head error distribution grouped by each sample's node count
+        (reference ``create_error_histogram_per_node``, visualizer.py:387):
+        shows whether bigger structures predict worse."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        t = np.asarray(true_values).ravel()
+        p = np.asarray(predicted_values).ravel()
+        counts = np.asarray(node_counts, np.int64)
+        assert counts.sum() == len(t), (counts.sum(), len(t))
+        sizes = np.repeat(counts, counts)
+        uniq = np.unique(sizes)
+        means = [float(np.mean(np.abs(p[sizes == u] - t[sizes == u]))) for u in uniq]
+        fig, (ax0, ax1) = plt.subplots(1, 2, figsize=(9, 3.5))
+        ax0.hist((p - t), bins=40)
+        ax0.set_xlabel("node error")
+        ax1.plot(uniq, means, "o-")
+        ax1.set_xlabel("nodes in structure")
+        ax1.set_ylabel("mean |error|")
+        out = os.path.join(self.dir, filename)
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out
+
+    def num_nodes_plot(self, samples, filename: str = "num_nodes.png") -> str:
+        """Histogram of structure sizes (reference ``num_nodes_plot``)."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        sizes = [s.num_nodes for s in samples]
+        fig, ax = plt.subplots(figsize=(5, 3.5))
+        ax.hist(sizes, bins=min(40, max(len(set(sizes)), 2)))
+        ax.set_xlabel("nodes per structure")
+        ax.set_ylabel("count")
+        out = os.path.join(self.dir, filename)
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out
+
+    # reference-name alias (``create_scatter_plots``, visualizer.py:692)
+    def create_scatter_plots(self, true_values, predicted_values, output_names=None):
+        return self.create_parity_plot(true_values, predicted_values, names=output_names)

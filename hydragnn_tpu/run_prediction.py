@@ -19,10 +19,34 @@ from .preprocess.load_data import dataset_loading_and_splitting
 from .train.step import TrainState, make_predict_step, resolve_precision
 
 
+def _allgather_ragged(arr: np.ndarray) -> np.ndarray:
+    """Concatenate per-process arrays of differing lengths (the reference's
+    cross-rank sample gather, train_validate_test.py:989-1080): exchange
+    lengths, pad to the max, allgather, strip, concatenate in rank order."""
+    from jax.experimental import multihost_utils
+
+    lengths = multihost_utils.process_allgather(
+        np.array([arr.shape[0]], np.int32)
+    ).reshape(-1)
+    max_len = int(lengths.max())
+    padded = np.zeros((max_len,) + arr.shape[1:], arr.dtype)
+    padded[: arr.shape[0]] = arr
+    gathered = multihost_utils.process_allgather(padded)
+    return np.concatenate(
+        [gathered[r, : int(lengths[r])] for r in range(len(lengths))], axis=0
+    )
+
+
 def run_prediction(config_source, state: TrainState, model=None, samples: Sequence | None = None):
     config = load_config(config_source)
+    world, rank = 1, 0
+    try:
+        if jax.process_count() > 1:
+            world, rank = jax.process_count(), jax.process_index()
+    except Exception:
+        pass
     train_loader, val_loader, test_loader = dataset_loading_and_splitting(
-        config, samples=samples
+        config, samples=samples, rank=rank, world=world
     )
     config = update_config(config, train_loader.samples, val_loader.samples, test_loader.samples)
     if model is None:
@@ -56,6 +80,19 @@ def run_prediction(config_source, state: TrainState, model=None, samples: Sequen
                 preds[ihead].append(np.asarray(out[ihead])[mask])
     true_values = [np.concatenate(t) for t in trues]
     predicted_values = [np.concatenate(p) for p in preds]
+    if world > 1:
+        # merge every process's test-shard predictions (reference's gather)
+        true_values = [_allgather_ragged(t) for t in true_values]
+        predicted_values = [_allgather_ragged(p) for p in predicted_values]
+
+    import os as _os
+
+    if int(_os.getenv("HYDRAGNN_DUMP_TESTDATA", "0")) == 1:
+        # reference dumps per-rank test pickles (train_validate_test.py:908)
+        import pickle
+
+        with open(f"testdata_rank{rank}.pickle", "wb") as f:
+            pickle.dump({"true": true_values, "pred": predicted_values}, f)
 
     # per-task losses + weighted total from the gathered arrays
     spec = model.spec

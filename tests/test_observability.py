@@ -77,3 +77,62 @@ def test_hpo_over_training(tmp_path):
     space = {"NeuralNetwork.Architecture.hidden_dim": [4, 8]}
     best_cfg, best_val, hist = run_hpo(base, space, objective, n_trials=2, seed=0)
     assert np.isfinite(best_val) and len(hist) == 2
+
+
+def test_visualizer_extended_plots(tmp_path):
+    """Vector parity, density parity, per-node error, size histogram
+    (reference visualizer.py:387-519,734)."""
+    import numpy as np
+
+    from hydragnn_tpu.postprocess.visualizer import Visualizer
+
+    rng = np.random.default_rng(0)
+    viz = Visualizer("viz_ext", path=str(tmp_path))
+
+    t_vec = rng.normal(size=(200, 3))
+    p_vec = t_vec + 0.05 * rng.normal(size=(200, 3))
+    out = viz.create_parity_plot_vector(t_vec, p_vec, name="forces",
+                                        component_names=["fx", "fy", "fz"])
+    assert out.endswith("parity_forces.png") and os.path.exists(out)
+
+    t = rng.normal(size=500)
+    p = t + 0.1 * rng.normal(size=500)
+    assert os.path.exists(viz.create_density_parity_plot(t, p, name="energy"))
+
+    counts = [5, 8, 12, 9, 6]
+    tn = rng.normal(size=sum(counts))
+    pn = tn + 0.1 * rng.normal(size=sum(counts))
+    assert os.path.exists(viz.create_error_histogram_per_node(tn, pn, counts))
+
+    class S:
+        def __init__(self, n):
+            self.num_nodes = n
+
+    assert os.path.exists(viz.num_nodes_plot([S(n) for n in (4, 9, 9, 16)]))
+    # reference-name alias
+    assert os.path.exists(viz.create_scatter_plots([t], [p], ["energy"]))
+
+
+def test_run_prediction_dump_testdata(tmp_path, monkeypatch):
+    """HYDRAGNN_DUMP_TESTDATA=1 writes per-rank test pickles (reference
+    train_validate_test.py:908)."""
+    import copy
+    import pickle
+
+    import numpy as np
+
+    import hydragnn_tpu
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from test_config import CI_CONFIG
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_DUMP_TESTDATA", "1")
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 1
+    samples = deterministic_graph_data(number_configurations=24, seed=3)
+    state, model, aug = hydragnn_tpu.run_training(cfg, samples=samples)
+    hydragnn_tpu.run_prediction(cfg, state, model, samples=samples)
+    with open("testdata_rank0.pickle", "rb") as f:
+        dump = pickle.load(f)
+    assert len(dump["true"]) == len(dump["pred"]) >= 1
+    assert np.asarray(dump["true"][0]).size > 0
