@@ -366,36 +366,50 @@ class GraphLoader:
             return n // self.batch_size
         return int(math.ceil(n / self.batch_size))
 
-    def __iter__(self) -> Iterable[GraphBatch]:
+    def batch_plan(self) -> list[tuple[np.ndarray, PadSpec]]:
+        """This epoch's (sample indices, bucket) per batch — the unit of work
+        a multi-worker prefetcher can collate in parallel."""
         perm = self._full_permutation()
         idx = perm[self.rank :: self.world] if self.world > 1 else perm
-        nb = len(self)
-        for b in range(nb):
+        plan = []
+        for b in range(len(self)):
             chunk = idx[b * self.batch_size : (b + 1) * self.batch_size]
             if len(chunk) == 0:
                 break
-            picked = [self.samples[i] for i in chunk]
-            pad = (
-                self._step_bucket(b, perm) if self.world > 1 else self._pick_bucket(picked)
-            )
-            yield collate(picked, pad)
+            if self.world > 1:
+                pad = self._step_bucket(b, perm)
+            else:
+                pad = self._pick_bucket([self.samples[i] for i in chunk])
+            plan.append((chunk, pad))
+        return plan
+
+    def collate_chunk(self, chunk: np.ndarray, pad: PadSpec) -> GraphBatch:
+        return collate([self.samples[i] for i in chunk], pad)
+
+    def __iter__(self) -> Iterable[GraphBatch]:
+        for chunk, pad in self.batch_plan():
+            yield self.collate_chunk(chunk, pad)
 
 
 class PrefetchLoader:
-    """Double-buffering wrapper: a daemon thread runs collate (and optionally
+    """Double-buffering wrapper: worker threads run collate (and optionally
     the host→device transfer) ``depth`` batches ahead of the consumer, so the
     chip never waits on the input pipeline. The reference gets this from its
     threaded, core-pinned ``HydraDataLoader`` (``preprocess/load_data.py:
     94-204``); here a queue + ``jax.device_put`` (async under dispatch) does
-    the same with no affinity games.
+    the same with no affinity games. ``workers > 1`` collates multiple
+    batches concurrently (order-preserving) when the wrapped loader exposes a
+    ``batch_plan`` — numpy copies release the GIL, so collate scales across
+    threads.
     """
 
     _DONE = object()
 
-    def __init__(self, loader, depth: int = 2, device_put: bool = True):
+    def __init__(self, loader, depth: int = 2, device_put: bool = True, workers: int = 1):
         self.loader = loader
         self.depth = max(1, int(depth))
         self.device_put = device_put
+        self.workers = max(1, int(workers))
         # delegate loader state the epoch loop touches
         self.samples = getattr(loader, "samples", [])
         self.pad = getattr(loader, "pad", None)
@@ -413,7 +427,36 @@ class PrefetchLoader:
 
         return jax.tree.map(jax.device_put, batch)
 
+    def _iter_pooled(self):
+        """Order-preserving multi-worker collate over the epoch's batch plan,
+        at most ``depth`` finished batches buffered ahead."""
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        plan = self.loader.batch_plan()
+        with ThreadPoolExecutor(max_workers=self.workers) as ex:
+            pending: deque = deque()
+            it = iter(plan)
+            try:
+                for _ in range(self.depth + self.workers - 1):
+                    chunk_pad = next(it, None)
+                    if chunk_pad is None:
+                        break
+                    pending.append(ex.submit(self.loader.collate_chunk, *chunk_pad))
+                while pending:
+                    batch = self._transfer(pending.popleft().result())
+                    chunk_pad = next(it, None)
+                    if chunk_pad is not None:
+                        pending.append(ex.submit(self.loader.collate_chunk, *chunk_pad))
+                    yield batch
+            finally:
+                for f in pending:
+                    f.cancel()
+
     def __iter__(self):
+        if self.workers > 1 and hasattr(self.loader, "batch_plan"):
+            yield from self._iter_pooled()
+            return
         import queue
         import threading
 

@@ -158,15 +158,22 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     # batches ahead of the step loop. Training.prefetch / HYDRAGNN_PREFETCH
     # set the depth; 0 disables.
     depth = int(os.getenv("HYDRAGNN_PREFETCH", training_cfg.get("prefetch", 2)))
+    workers = int(training_cfg.get("num_workers", 1))
     if depth > 0:
         from .graphs.batching import PrefetchLoader
 
         # under a mesh the loop stacks host batches itself: prefetch the
         # collate work but leave device placement to put_batch
         dput = mesh is None
-        train_loader = PrefetchLoader(train_loader, depth=depth, device_put=dput)
-        val_loader = PrefetchLoader(val_loader, depth=depth, device_put=dput)
-        test_loader = PrefetchLoader(test_loader, depth=depth, device_put=dput)
+        train_loader = PrefetchLoader(
+            train_loader, depth=depth, device_put=dput, workers=workers
+        )
+        val_loader = PrefetchLoader(
+            val_loader, depth=depth, device_put=dput, workers=workers
+        )
+        test_loader = PrefetchLoader(
+            test_loader, depth=depth, device_put=dput, workers=workers
+        )
 
     state = train_validate_test(
         model,
