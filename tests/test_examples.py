@@ -75,3 +75,19 @@ def test_example_multidataset_packed(tmp_path):
     )
     assert "mesh: (2 branch x 4 data)" in out2
     assert "epoch 0" in out2
+
+
+def test_example_oc20_s2ef(tmp_path):
+    """OC20-style S2EF driver: packed store -> MLIP energy+force training."""
+    d = str(tmp_path / "oc20")
+    out = run_example(
+        ["examples/oc20/train.py", "--make-synthetic", d, "--configs", "24",
+         "--epochs", "2", "--batch", "4"]
+    )
+    assert "synthesized S2EF store" in out
+    assert "S2EF metrics" in out
+    out2 = run_example(
+        ["examples/oc20/train.py", "--data", f"{d}/s2ef.gpk", "--epochs", "1",
+         "--batch", "4"]
+    )
+    assert "24 structures" in out2
