@@ -339,6 +339,13 @@ def main():
         _emit(record)
         return
 
+    try:
+        from hydragnn_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
+    except Exception:
+        pass
+
     batch_size = int(os.getenv("BENCH_BATCH_SIZE", "256"))
     bench_steps = int(os.getenv("BENCH_STEPS", "30"))
     warmup = int(os.getenv("BENCH_WARMUP", "5"))

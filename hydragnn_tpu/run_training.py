@@ -27,6 +27,12 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     verbosity = config.get("Verbosity", {}).get("level", 0)
     training_cfg = config.get("NeuralNetwork", {}).get("Training", {})
 
+    # persistent XLA compile cache: reruns/HPO trials skip the 20-40 s TPU
+    # compile (HYDRAGNN_COMPILE_CACHE=0 disables)
+    from .utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
     # multi-host bootstrap (reference setup_ddp, distributed.py:151-280):
     # scheduler env cascade -> jax.distributed.initialize; no-op/idempotent in
     # single-process runs. Caller-supplied rank/world win if explicit.

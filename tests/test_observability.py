@@ -136,3 +136,17 @@ def test_run_prediction_dump_testdata(tmp_path, monkeypatch):
         dump = pickle.load(f)
     assert len(dump["true"]) == len(dump["pred"]) >= 1
     assert np.asarray(dump["true"][0]).size > 0
+
+
+def test_compile_cache_enable(tmp_path, monkeypatch):
+    import hydragnn_tpu.utils.compile_cache as cc
+
+    monkeypatch.setenv("HYDRAGNN_COMPILE_CACHE", str(tmp_path / "cache"))
+    monkeypatch.setattr(cc, "_enabled", False)
+    assert cc.enable_compile_cache() == str(tmp_path / "cache")
+    assert os.path.isdir(str(tmp_path / "cache"))
+    # idempotent
+    assert cc.enable_compile_cache() == str(tmp_path / "cache")
+    monkeypatch.setenv("HYDRAGNN_COMPILE_CACHE", "0")
+    monkeypatch.setattr(cc, "_enabled", False)
+    assert cc.enable_compile_cache() is None
