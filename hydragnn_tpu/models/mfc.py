@@ -34,8 +34,12 @@ class MFCConv(nn.Module):
         N = batch.num_nodes
         in_dim = inv.shape[-1]
 
-        msg = inv[batch.senders] * batch.edge_mask[:, None]
-        agg = segment.segment_sum(msg, batch.receivers, N)
+        from ..ops import gather_scatter_sum
+
+        agg = gather_scatter_sum(
+            inv, batch.senders, batch.receivers, N,
+            weight=batch.edge_mask.astype(inv.dtype),
+        )
         deg = segment.segment_sum(batch.edge_mask, batch.receivers, N)
         deg_idx = jnp.clip(deg.astype(jnp.int32), 0, max_deg)
 

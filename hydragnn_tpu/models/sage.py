@@ -25,9 +25,17 @@ class SAGEConv(nn.Module):
         self, inv: jax.Array, equiv: jax.Array, batch: GraphBatch, train: bool = False
     ):
         hidden = self.out_dim or self.spec.hidden_dim
-        # padded edges route to the dummy node, so segment_mean over receivers
-        # is already the masked neighbor mean for real nodes
-        msg = inv[batch.senders] * batch.edge_mask[:, None]
-        agg = segment.segment_mean(msg, batch.receivers, batch.num_nodes)
+        # fused gather+mask+scatter (ops.fused_scatter), then the neighbor
+        # mean; padded edges route to the dummy node so the masked count is
+        # already the real in-degree
+        from ..ops import gather_scatter_sum
+
+        N = batch.num_nodes
+        total = gather_scatter_sum(
+            inv, batch.senders, batch.receivers, N,
+            weight=batch.edge_mask.astype(inv.dtype),
+        )
+        count = segment.segment_count(batch.receivers, N, weights=batch.edge_mask)
+        agg = total / jnp.maximum(count, 1e-12).astype(total.dtype)[:, None]
         out = nn.Dense(hidden, name="lin_root")(inv) + nn.Dense(hidden, name="lin_nbr")(agg)
         return out, equiv

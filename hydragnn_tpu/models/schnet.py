@@ -61,8 +61,14 @@ class SchNetConv(nn.Module):
         w = w * cosine_cutoff(dist, cutoff)[:, None]
 
         x = nn.Dense(nf, use_bias=False, name="lin1")(inv)
-        msg = x[batch.senders] * w * batch.edge_mask[:, None]
-        agg = segment.segment_sum(msg, batch.receivers, batch.num_nodes)
+        # fused gather+filter+scatter: the CFConv hot path in one kernel
+        # (vector edge weight = learned filter x mask)
+        from ..ops import gather_scatter_sum
+
+        agg = gather_scatter_sum(
+            x, batch.senders, batch.receivers, batch.num_nodes,
+            weight=(w * batch.edge_mask[:, None]).astype(x.dtype),
+        )
         out = nn.Dense(hidden, name="lin2")(agg)
 
         if equivariant:

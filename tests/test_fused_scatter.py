@@ -191,3 +191,35 @@ def test_gin_training_parity_with_fused_kernel(monkeypatch):
         results["0"][1],
         results["1"][1],
     )
+
+
+def test_schnet_forward_parity_with_fused_kernel(monkeypatch):
+    """SchNet's CFConv uses the vector-weight fused path; forward must match
+    the XLA route bit-for-bit-ish."""
+    import copy
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+    from hydragnn_tpu.models import create_model_config, init_model
+    from hydragnn_tpu.preprocess import apply_variables_of_interest
+    from test_config import CI_CONFIG
+
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"].update(
+        {"mpnn_type": "SchNet", "num_gaussians": 10, "num_filters": 8}
+    )
+    samples = deterministic_graph_data(number_configurations=8, seed=5)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 8)
+    batch = jax.tree.map(jnp.asarray, collate(samples, pad))
+    variables = init_model(model, batch)
+
+    outs = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("HYDRAGNN_FUSED_SCATTER", flag)
+        outs[flag] = model.apply(variables, batch, train=False)
+    for a, b in zip(jax.tree.leaves(outs["0"]), jax.tree.leaves(outs["1"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
