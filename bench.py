@@ -249,6 +249,34 @@ def bench_gin(batch_size: int, bench_steps: int, warmup: int) -> dict:
     )
 
 
+def bench_gps(batch_size: int, bench_steps: int, warmup: int) -> dict:
+    """GPS (local GIN + per-graph dense-block attention), bf16 — measures the
+    O(sum n_i^2) attention redesign."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.train import make_train_step
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch.update(
+        {"hidden_dim": 64, "global_attn_engine": "GPS", "global_attn_heads": 4,
+         "pe_dim": 4}
+    )
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    cfg["NeuralNetwork"]["Training"]["precision"] = "bf16"
+    samples = make_qm9_like_samples(max(batch_size * 4, 256))
+    from hydragnn_tpu.preprocess.encodings import attach_lap_pe
+
+    for s in samples:
+        attach_lap_pe(s, 4)
+    return _run_workload(
+        "gps_gin_dense", cfg, samples,
+        lambda m, o: make_train_step(m, o, compute_dtype=jnp.bfloat16),
+        "bf16", batch_size, bench_steps, warmup,
+    )
+
+
 def bench_mlip(batch_size: int, bench_steps: int, warmup: int) -> dict:
     """EGNN energy+force training (jax.grad forces) on LJ-like molecules.
     fp32 compute: bf16 under grad-of-grad loses force accuracy, so this is
@@ -354,6 +382,7 @@ def main():
     for name, fn, bs in (
         ("gin", bench_gin, batch_size),
         ("mlip", bench_mlip, min(batch_size, 64)),
+        ("gps", bench_gps, min(batch_size, 128)),
     ):
         try:
             workloads[name] = fn(bs, bench_steps, warmup)
