@@ -301,3 +301,23 @@ def test_batch_plan_matches_iteration():
     for (chunk, pad), b in zip(plan, batches):
         assert b.x.shape[0] == pad.n_node
         assert int(b.graph_mask.sum()) == len(chunk)
+
+
+def test_run_training_with_buckets_and_workers(monkeypatch, tmp_path):
+    """Training.pad_buckets + prefetch + num_workers end-to-end on a single
+    device (the bucketed path is disabled under in-process meshes)."""
+    import copy
+
+    import hydragnn_tpu
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from test_config import CI_CONFIG
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_AUTO_PARALLEL", "0")
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Training"].update(
+        {"num_epoch": 2, "pad_buckets": 3, "prefetch": 2, "num_workers": 2}
+    )
+    samples = deterministic_graph_data(number_configurations=40, seed=23)
+    state, model, aug = hydragnn_tpu.run_training(cfg, samples=samples)
+    assert int(np.asarray(state.step)) > 0
