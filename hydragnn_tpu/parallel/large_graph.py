@@ -62,28 +62,46 @@ def _no_fused_scatter():
             os.environ["HYDRAGNN_FUSED_SCATTER"] = prev
 
 
-def edge_batch_shardings(mesh: Mesh) -> GraphBatch:
-    """Edge-dimension fields split over the data axis; everything else
+# GraphBatch fields whose leading axis is the node dimension.
+_NODE_FIELDS = frozenset(
+    {"x", "pos", "batch", "node_y", "forces_y", "node_mask", "pe", "z"}
+)
+
+
+def edge_batch_shardings(mesh: Mesh, shard_nodes: bool = False) -> GraphBatch:
+    """Edge-dimension fields split over the data axis; node fields split too
+    when ``shard_nodes`` (at-rest node memory 1/D — XLA all-gathers node
+    features right before each layer's gather, ZeRO-style); everything else
     replicated."""
-    edge = NamedSharding(mesh, P(DATA_AXIS))
+    split = NamedSharding(mesh, P(DATA_AXIS))
     rep = NamedSharding(mesh, P())
-    return GraphBatch(
-        *[(edge if f in _EDGE_FIELDS else rep) for f in GraphBatch._fields]
-    )
+
+    def pick(f):
+        if f in _EDGE_FIELDS:
+            return split
+        if shard_nodes and f in _NODE_FIELDS:
+            return split
+        return rep
+
+    return GraphBatch(*[pick(f) for f in GraphBatch._fields])
 
 
-def put_large_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
-    """Place one (possibly giant) collated batch with edge arrays sharded.
-    Pads the edge dimension to a multiple of the data-axis size with masked
-    edges wired to the padding node (shape-preserving semantics)."""
+def put_large_batch(
+    batch: GraphBatch, mesh: Mesh, shard_nodes: bool = False
+) -> GraphBatch:
+    """Place one (possibly giant) collated batch with edge (and optionally
+    node) arrays sharded. Pads the sharded dimensions to multiples of the
+    data-axis size with masked fill (shape-preserving semantics)."""
     n_dev = mesh.shape[DATA_AXIS]
     n_node = np.asarray(batch.x).shape[0]
     e_padded = np.asarray(batch.senders).shape[0]
     e_padded += -e_padded % n_dev
+    n_graph = np.asarray(batch.graph_y).shape[0]
+    sharded_fields = _EDGE_FIELDS | (_NODE_FIELDS if shard_nodes else frozenset())
 
     def pad_field(name, arr):
         arr = np.asarray(arr)
-        if name not in _EDGE_FIELDS:
+        if name not in sharded_fields:
             return arr
         pad = -arr.shape[0] % n_dev
         if not pad:
@@ -92,13 +110,18 @@ def put_large_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
             fill = n_node - 1  # masked pad edges wired to the padding node
         elif name in ("idx_kj", "idx_ji"):
             fill = e_padded - 1  # pad triplets point at a padded edge
+        elif name == "batch":
+            fill = n_graph - 1  # pad nodes belong to the dummy graph
         else:
             fill = 0
         width = ((0, pad),) + ((0, 0),) * (arr.ndim - 1)
         return np.pad(arr, width, constant_values=fill)
 
+    # node padding changes num_nodes: pad-edge endpoints must still point at
+    # a PADDING node; node n_node-1 is one by the collate contract, and pads
+    # added here extend the padding tail, so fills above stay valid.
     batch = GraphBatch(*[pad_field(f, v) for f, v in zip(GraphBatch._fields, batch)])
-    sh = edge_batch_shardings(mesh)
+    sh = edge_batch_shardings(mesh, shard_nodes)
     return jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s), batch, sh)
 
 

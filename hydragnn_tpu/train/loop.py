@@ -199,11 +199,16 @@ def train_validate_test(
             put_large_batch,
         )
 
+        # edge_sharding: true -> edges sharded, nodes replicated;
+        # "full" (or "nodes") -> node arrays sharded too (at-rest 1/D)
+        shard_nodes = str(
+            config_nn.get("Architecture", {}).get("edge_sharding")
+        ).lower() in ("full", "nodes")
         train_step = make_edge_sharded_train_step(
             model, optimizer, mesh, compute_dtype=precision
         )
         eval_step = make_edge_sharded_eval_step(model, mesh, compute_dtype=precision)
-        put_fn = _partial(put_large_batch, mesh=mesh)
+        put_fn = _partial(put_large_batch, mesh=mesh, shard_nodes=shard_nodes)
     elif mesh is not None:
         from ..parallel.step import make_parallel_eval_step, make_parallel_train_step
 
