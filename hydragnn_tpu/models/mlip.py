@@ -23,6 +23,8 @@ summed into a graph energy (``:654-658``).
 
 from __future__ import annotations
 
+import functools
+
 from typing import NamedTuple
 
 import jax
@@ -166,7 +168,9 @@ def make_mlip_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32
         tot, tasks = energy_force_loss(spec, graph_e, forces, batch)
         return tot, (tasks, new_stats)
 
-    @jax.jit
+    from ..train.step import donate_state_argnums
+
+    @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
     def train_step(state: TrainState, batch: GraphBatch):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
         (tot, (tasks, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(

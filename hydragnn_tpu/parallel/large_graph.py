@@ -35,7 +35,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..graphs.graph import GraphBatch
 from ..models.base import HydraModel
-from ..train.step import TrainState, _cast_floats, freeze_conv_grads
+from ..train.step import (
+    TrainState,
+    _cast_floats,
+    donate_state_argnums,
+    freeze_conv_grads,
+)
 from .mesh import DATA_AXIS
 
 # GraphBatch fields whose leading axis is the edge (or triplet) dimension.
@@ -161,7 +166,9 @@ def make_edge_sharded_train_step(
         tot, tasks = model.loss(pred, batch)
         return tot, (tasks, updates["batch_stats"])
 
-    @jax.jit
+    from functools import partial as _p
+
+    @_p(jax.jit, donate_argnums=donate_state_argnums())
     def step(state: TrainState, batch: GraphBatch):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
         (tot, (tasks, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(

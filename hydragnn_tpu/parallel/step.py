@@ -33,7 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..graphs.graph import GraphBatch
 from ..models.base import HydraModel
-from ..train.step import TrainState, _cast_floats
+from ..train.step import TrainState, _cast_floats, donate_state_argnums as _donate
 from .mesh import DATA_AXIS, fsdp_param_specs
 
 
@@ -154,7 +154,7 @@ def make_parallel_train_step(
         new_stats = jax.tree.map(lambda x: x.mean(axis=0), new_stats)
         return loss, (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=_donate())
     def train_step(state: TrainState, batches: GraphBatch):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
         (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
@@ -299,7 +299,7 @@ def _make_parallel_mlip_train_step(
         new_stats = jax.tree.map(lambda x: x.mean(axis=0), new_stats)
         return tots.sum() / denom, (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=_donate())
     def train_step(state: TrainState, batches: GraphBatch):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
         (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
