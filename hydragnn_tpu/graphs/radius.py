@@ -64,6 +64,17 @@ def _pairs_within(
         qi, pj = np.nonzero(d2 <= r2)
         return qi, pj
 
+    # large systems: the native multithreaded cell list (the reference's
+    # vesin role) when built; HYDRAGNN_NATIVE=0 forces the numpy path
+    import os
+
+    if os.getenv("HYDRAGNN_NATIVE", "1") != "0":
+        from ..native import pairs_within_native
+
+        native = pairs_within_native(query, points, radius)
+        if native is not None:
+            return native
+
     mins = np.minimum(query.min(axis=0), points.min(axis=0))
     qbins = np.floor((query - mins) / radius).astype(np.int64)
     pbins = np.floor((points - mins) / radius).astype(np.int64)

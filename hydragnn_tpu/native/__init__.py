@@ -90,3 +90,76 @@ def gather_blocks(
         lib.gpk_gather_mt(src_p, i64p(so), i64p(nb), i64p(do), dst_p, n, threads)
     else:
         lib.gpk_gather(src_p, i64p(so), i64p(nb), i64p(do), dst_p, n)
+
+
+# ---------------------------------------------------------------------------
+# Cell-list neighbor search (the reference's vesin role)
+# ---------------------------------------------------------------------------
+
+_RG_SO = os.path.join(_HERE, "libradius_graph.so")
+_RG_SRC = os.path.join(_HERE, "radius_graph.cpp")
+_rg_lib = None
+_rg_failed = False
+
+
+def get_radius_lib():
+    global _rg_lib, _rg_failed
+    if _rg_lib is not None or _rg_failed:
+        return _rg_lib
+    if not os.path.exists(_RG_SO) or os.path.getmtime(_RG_SO) < os.path.getmtime(
+        _RG_SRC
+    ):
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _RG_SO,
+                 _RG_SRC, "-lpthread"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except Exception:
+            _rg_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(_RG_SO)
+        lib.pairs_within.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.pairs_within.restype = ctypes.c_int64
+        _rg_lib = lib
+    except OSError:
+        _rg_failed = True
+    return _rg_lib
+
+
+def pairs_within_native(
+    query: np.ndarray, points: np.ndarray, radius: float, threads: int = 0
+):
+    """All (qi, pj) with ||points[pj] - query[qi]|| <= radius via the native
+    cell list; None when the native library is unavailable."""
+    lib = get_radius_lib()
+    if lib is None:
+        return None
+    q = np.ascontiguousarray(query, np.float64)
+    p = np.ascontiguousarray(points, np.float64)
+    nq, npts = q.shape[0], p.shape[0]
+    if threads <= 0:
+        threads = min(os.cpu_count() or 1, 8)
+    cap = max(64 * nq, 1024)
+    f64p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    i64p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+    for _ in range(2):
+        out_q = np.empty(cap, np.int64)
+        out_p = np.empty(cap, np.int64)
+        n = lib.pairs_within(
+            f64p(q), nq, f64p(p), npts, float(radius),
+            i64p(out_q), i64p(out_p), cap, int(threads),
+        )
+        if n >= 0:
+            return out_q[:n], out_p[:n]
+        cap = -n
+    return None  # pragma: no cover — second pass always fits
