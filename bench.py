@@ -208,7 +208,12 @@ def _run_workload(
     train_step = make_step(model, optimizer)
 
     state, _ = _time_steps(train_step, state, batches, warmup)
-    state, dt = _time_steps(train_step, state, batches, max(bench_steps, 1))
+    profile_dir = os.getenv("BENCH_PROFILE")
+    if profile_dir:
+        with jax.profiler.trace(os.path.join(profile_dir, name)):
+            state, dt = _time_steps(train_step, state, batches, max(bench_steps, 1))
+    else:
+        state, dt = _time_steps(train_step, state, batches, max(bench_steps, 1))
     bench_steps = max(bench_steps, 1)
 
     n_chips = jax.device_count()

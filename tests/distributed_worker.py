@@ -85,6 +85,8 @@ def main() -> None:
     os.chdir(outdir)
     samples = deterministic_graph_data(number_configurations=48, seed=5)
 
+    if mode == "fsdp":
+        os.environ["HYDRAGNN_USE_FSDP"] = "1"
     if mode == "packed":
         # cross-host data plane: rank 0 writes the packed store, a global
         # barrier publishes it, then EVERY rank reads lazily with per-epoch

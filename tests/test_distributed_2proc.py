@@ -76,3 +76,11 @@ def test_two_process_training_from_packed_store(tmp_path):
     ranks partition the whole store every epoch."""
     results = _run_workers(tmp_path, "packed")
     assert results[0]["param_l1"] == pytest.approx(results[1]["param_l1"], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_fsdp_training(tmp_path):
+    """ZeRO-3 across PROCESSES: params sharded over the 2-process global
+    mesh; both workers must still agree on their (gathered) param norms."""
+    results = _run_workers(tmp_path, "fsdp")
+    assert results[0]["param_l1"] > 0
