@@ -49,6 +49,7 @@ class GraphMultiheadAttention(nn.Module):
     channels: int
     heads: int
     n_max: int = 0
+    ring: bool = False  # rotate K/V shards over the mesh (giant graphs)
 
     def _flat_attention(self, q, k, v, batch: GraphBatch):
         Dh = q.shape[-1]
@@ -89,6 +90,19 @@ class GraphMultiheadAttention(nn.Module):
         q = nn.Dense(self.channels, name="q")(h).reshape(N, H, Dh)
         k = nn.Dense(self.channels, name="k")(h).reshape(N, H, Dh)
         v = nn.Dense(self.channels, name="v")(h).reshape(N, H, Dh)
+        if self.ring:
+            # giant-graph path: K/V shards rotate around the mesh ring with
+            # an online softmax — O(N/D) peak memory, exact results
+            from ..parallel.ring_attention import get_global_mesh, ring_attention
+
+            mesh = get_global_mesh()
+            if mesh is not None and N % mesh.shape["data"] == 0:
+                out = ring_attention(
+                    q, k, v, batch.batch, batch.node_mask, mesh
+                )
+                return nn.Dense(self.channels, name="out")(
+                    out.reshape(N, self.channels)
+                )
         if self.n_max and self.n_max < N:
             fits = jnp.all(batch.n_node <= self.n_max)
             out = jax.lax.cond(
@@ -203,7 +217,8 @@ class GPSConv(nn.Module):
             h_local = h_local + inv  # residual
         h_local = MaskedBatchNorm(name="norm1")(h_local, batch.node_mask, train)
 
-        if (spec.global_attn_type or "multihead") == "performer":
+        attn_type = spec.global_attn_type or "multihead"
+        if attn_type == "performer":
             attn_mod = PerformerAttention(
                 channels=inv.shape[-1], heads=max(spec.global_attn_heads, 1),
                 name="attn",
@@ -211,7 +226,8 @@ class GPSConv(nn.Module):
         else:
             attn_mod = GraphMultiheadAttention(
                 channels=inv.shape[-1], heads=max(spec.global_attn_heads, 1),
-                n_max=spec.max_graph_nodes or 0, name="attn",
+                n_max=spec.max_graph_nodes or 0, ring=(attn_type == "ring"),
+                name="attn",
             )
         h_attn = attn_mod(inv, batch, train)
         h_attn = drop(h_attn, deterministic=not train)

@@ -126,6 +126,10 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             mesh = make_mesh()
             param_mode = "fsdp" if os.getenv("HYDRAGNN_USE_FSDP") == "1" else "replicated"
             state = shard_state(state, mesh, param_mode=param_mode)
+            # publish the mesh for trace-time consumers (ring attention)
+            from .parallel.ring_attention import set_global_mesh
+
+            set_global_mesh(mesh)
             print_distributed(verbosity, f"auto-parallel: {n_dev}-device data mesh ({param_mode})")
     except Exception as e:
         if os.getenv("HYDRAGNN_USE_FSDP") == "1":
