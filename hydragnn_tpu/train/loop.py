@@ -70,6 +70,22 @@ def _grouped(loader, n: int, mesh, fill: bool = False):
         yield put_batch(stack_device_batches(group), mesh)
 
 
+_SENTINEL = object()
+
+
+def _timed_iter(iterable, span: str = "dataload"):
+    """Attribute host wait-for-batch time to a tracer span (the reference's
+    GPTL dataload region, train_validate_test.py:678-777)."""
+    it = iter(iterable)
+    while True:
+        tr.start(span)
+        batch = next(it, _SENTINEL)
+        tr.stop(span)
+        if batch is _SENTINEL:
+            return
+        yield batch
+
+
 def _local_device_count(mesh) -> int:
     """Batches grouped per step on THIS process: each process stacks only its
     addressable devices' shard; put_batch assembles the global array."""
@@ -92,7 +108,7 @@ def train_epoch(
         # the HYDRAGNN_MAX_NUM_BATCH cap counts raw loader batches; each
         # grouped step consumes n_dev of them
         nbatch = max(1, -(-nbatch // n_dev))
-    it = (
+    it = _timed_iter(
         _grouped(loader, n_dev, mesh)
         if grouped
         else iterate_tqdm(loader, verbosity, desc="train", total=nbatch)
