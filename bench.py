@@ -15,8 +15,20 @@ FLOPs from XLA cost analysis, MFU vs the chip's peak) plus environment info.
 
 This script must NEVER die with a traceback or hang silently: any failure
 (e.g. the axon TPU tunnel down or wedged, as in round 1's BENCH_r01.json)
-degrades to a diagnostic JSON record with ``"error"`` set and exit code 0,
-enforced by a whole-run watchdog timer.
+degrades to a diagnostic JSON record with ``"error"`` set and exit code 0.
+
+Robustness architecture (round-2 lesson: a watchdog *thread* can be starved
+by a C call holding the GIL, and ``os._exit`` mid-TPU-operation can wedge
+the axon tunnel for subsequent clients):
+
+* the PARENT process never imports jax — it spawns a measurement CHILD and
+  owns the deadline (``BENCH_TOTAL_TIMEOUT``), so it can always emit;
+* the CHILD appends one JSON line per completed workload to a status file,
+  so a timeout preserves partial results instead of losing everything;
+* on deadline the child gets SIGINT → SIGTERM → SIGKILL with grace gaps,
+  giving the TPU runtime a chance to disconnect cleanly;
+* the child checks the remaining global budget before starting each
+  workload and records a skip instead of starting what cannot finish.
 """
 
 from __future__ import annotations
@@ -320,56 +332,44 @@ def _prev_value() -> float | None:
     return prev
 
 
-def _probe_backend(record: dict, timeout_s: float) -> bool:
-    """Initialize the JAX backend in a daemon thread. The axon TPU tunnel can
-    HANG on init (not just raise, round-1 failure mode) — probing from a
-    joinable thread turns the hang into a diagnosable timeout."""
-    result: dict = {}
-
-    def probe():
-        try:
-            import jax
-
-            result["platform"] = jax.default_backend()
-            result["device_kind"] = jax.devices()[0].device_kind
-            result["n_devices"] = jax.device_count()
-        except Exception:
-            result["error"] = "backend_init_failed: " + traceback.format_exc(limit=3)
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
-        record["error"] = f"backend_init_timeout_after_{timeout_s}s (axon tunnel hung)"
-        return False
-    record.update(result)
-    return "error" not in result
+def _status_write(path: str, record: dict) -> None:
+    """Append one JSON line to the child→parent status file (line-buffered)."""
+    with open(path, "a") as fh:
+        fh.write(json.dumps(record) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
 
 
-def main():
-    record = {
-        "metric": "train_throughput_qm9like_gin_bf16",
-        "value": 0.0,
-        "unit": "graphs/sec/chip",
-        "vs_baseline": 1.0,
-    }
+def child_main(status_path: str) -> None:
+    """Measurement process: probe the backend, run workloads, stream each
+    result to the status file the moment it exists. Exits normally (no
+    ``os._exit``) so the TPU runtime disconnects cleanly."""
+    t_start = time.perf_counter()
+    total = float(os.getenv("BENCH_TOTAL_TIMEOUT", "1500"))
+    deadline = max(total - 90.0, total * 0.5)
 
-    # Whole-run watchdog: if anything past backend init wedges (device_put or
-    # a step riding a dying tunnel), emit the diagnostic line and hard-exit —
-    # the driver must always get its JSON.
-    total_timeout = float(os.getenv("BENCH_TOTAL_TIMEOUT", "1500"))
+    try:
+        import jax
 
-    def die():
-        record.setdefault("error", f"bench_wedged_after_{total_timeout}s (watchdog)")
-        _emit(record)
-        os._exit(0)
-
-    watchdog = threading.Timer(total_timeout, die)
-    watchdog.daemon = True
-    watchdog.start()
-
-    if not _probe_backend(record, float(os.getenv("BENCH_INIT_TIMEOUT", "300"))):
-        _emit(record)
+        # the machine's sitecustomize force-registers the axon TPU plugin and
+        # overrides env platform selection; re-assert the caller's choice so
+        # CPU smoke runs (JAX_PLATFORMS=cpu) really run on CPU
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        _status_write(
+            status_path,
+            {
+                "kind": "backend",
+                "platform": jax.default_backend(),
+                "device_kind": jax.devices()[0].device_kind,
+                "n_devices": jax.device_count(),
+            },
+        )
+    except Exception:
+        _status_write(
+            status_path,
+            {"kind": "backend", "error": "backend_init_failed: " + traceback.format_exc(limit=3)},
+        )
         return
 
     try:
@@ -382,63 +382,193 @@ def main():
     batch_size = int(os.getenv("BENCH_BATCH_SIZE", "256"))
     bench_steps = int(os.getenv("BENCH_STEPS", "30"))
     warmup = int(os.getenv("BENCH_WARMUP", "5"))
-    workloads = {}
-    errors = {}
-    for name, fn, bs in (
-        ("gin", bench_gin, batch_size),
-        ("mlip", bench_mlip, min(batch_size, 64)),
-        ("gps", bench_gps, min(batch_size, 128)),
-    ):
-        try:
-            workloads[name] = fn(bs, bench_steps, warmup)
-        except Exception:
-            errors[name] = traceback.format_exc(limit=5)
 
-    # A/B the Pallas fused-scatter kernel on the gin workload (default state
-    # restored afterwards); speedup > 1 means the kernel wins on this chip
-    if "gin" in workloads and os.getenv("BENCH_FUSED_AB", "1") != "0":
-        prev_flag = os.environ.get("HYDRAGNN_FUSED_SCATTER")
-        try:
-            os.environ["HYDRAGNN_FUSED_SCATTER"] = "0"
-            off = bench_gin(batch_size, max(bench_steps // 2, 5), warmup)
-            os.environ["HYDRAGNN_FUSED_SCATTER"] = "1"
-            on = bench_gin(batch_size, max(bench_steps // 2, 5), warmup)
-            workloads["gin"]["fused_scatter_speedup"] = round(
-                off["step_ms"] / on["step_ms"], 4
+    plan: list = [
+        ("gin", lambda: bench_gin(batch_size, bench_steps, warmup)),
+        ("mlip", lambda: bench_mlip(min(batch_size, 64), bench_steps, warmup)),
+        ("gps", lambda: bench_gps(min(batch_size, 128), bench_steps, warmup)),
+    ]
+    if os.getenv("BENCH_FUSED_AB", "1") != "0":
+        def fused_ab():
+            prev_flag = os.environ.get("HYDRAGNN_FUSED_SCATTER")
+            try:
+                os.environ["HYDRAGNN_FUSED_SCATTER"] = "0"
+                off = bench_gin(batch_size, max(bench_steps // 2, 5), warmup)
+                os.environ["HYDRAGNN_FUSED_SCATTER"] = "1"
+                on = bench_gin(batch_size, max(bench_steps // 2, 5), warmup)
+                return {
+                    "fused_scatter_speedup": round(off["step_ms"] / on["step_ms"], 4),
+                    "step_ms_fused_off": off["step_ms"],
+                    "step_ms_fused_on": on["step_ms"],
+                }
+            finally:
+                if prev_flag is None:
+                    os.environ.pop("HYDRAGNN_FUSED_SCATTER", None)
+                else:
+                    os.environ["HYDRAGNN_FUSED_SCATTER"] = prev_flag
+
+        plan.append(("fused_ab", fused_ab))
+
+    done: set = set()
+    for name, fn in plan:
+        elapsed = time.perf_counter() - t_start
+        if elapsed > deadline:
+            _status_write(
+                status_path,
+                {"kind": "workload", "name": name,
+                 "error": f"skipped: global budget spent ({elapsed:.0f}s elapsed)"},
             )
-            workloads["gin"]["step_ms_fused_off"] = off["step_ms"]
-            workloads["gin"]["step_ms_fused_on"] = on["step_ms"]
+            continue
+        if name == "fused_ab" and "gin" not in done:
+            _status_write(
+                status_path,
+                {"kind": "workload", "name": name, "error": "skipped: gin workload failed"},
+            )
+            continue
+        try:
+            rec = fn()
+            _status_write(status_path, {"kind": "workload", "name": name, "result": rec})
+            done.add(name)
         except Exception:
-            errors["fused_ab"] = traceback.format_exc(limit=3)
-        finally:
-            if prev_flag is None:
-                os.environ.pop("HYDRAGNN_FUSED_SCATTER", None)
-            else:
-                os.environ["HYDRAGNN_FUSED_SCATTER"] = prev_flag
+            _status_write(
+                status_path,
+                {"kind": "workload", "name": name, "error": traceback.format_exc(limit=5)},
+            )
 
-    if "gin" in workloads:
+
+def _assemble(status_path: str, note: str | None) -> dict:
+    record = {
+        "metric": "train_throughput_qm9like_gin_bf16",
+        "value": 0.0,
+        "unit": "graphs/sec/chip",
+        "vs_baseline": 1.0,
+    }
+    workloads: dict = {}
+    errors: dict = {}
+    lines = []
+    try:
+        with open(status_path) as fh:
+            for ln in fh:
+                if not ln.strip():
+                    continue
+                try:
+                    lines.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass  # torn final line from a SIGKILLed child
+    except FileNotFoundError:
+        pass
+    for rec in lines:
+        if rec.get("kind") == "backend":
+            for k in ("platform", "device_kind", "n_devices"):
+                if k in rec:
+                    record[k] = rec[k]
+            if "error" in rec:
+                errors["backend"] = rec["error"]
+        elif rec.get("kind") == "workload":
+            if "result" in rec:
+                if rec["name"] == "fused_ab":
+                    workloads.setdefault("gin", {}).update(rec["result"])
+                else:
+                    workloads.setdefault(rec["name"], {}).update(rec["result"])
+            else:
+                errors[rec["name"]] = rec.get("error", "unknown")
+    if workloads.get("gin", {}).get("graphs_per_sec_per_chip"):
         record["value"] = workloads["gin"]["graphs_per_sec_per_chip"]
         prev = _prev_value()
         record["vs_baseline"] = round(record["value"] / prev, 3) if prev else 1.0
-    record["workloads"] = workloads
+    if workloads:
+        record["workloads"] = workloads
+    if note:
+        errors["parent"] = note  # distinct key: keep the child's traceback too
     if errors:
-        record["error"] = "; ".join(f"{k}: {v.splitlines()[-1]}" for k, v in errors.items())
+        record["error"] = "; ".join(
+            f"{k}: {str(v).splitlines()[-1]}" for k, v in errors.items()
+        )
         record["error_detail"] = errors
-    watchdog.cancel()
-    _emit(record)
+    return record
+
+
+def parent_main() -> None:
+    """Deadline owner: spawns the measurement child, polls its status file,
+    emits exactly one JSON line no matter what the child (or the TPU
+    tunnel under it) does."""
+    import signal
+    import subprocess
+    import tempfile
+
+    total_timeout = float(os.getenv("BENCH_TOTAL_TIMEOUT", "1500"))
+    init_timeout = float(os.getenv("BENCH_INIT_TIMEOUT", "300"))
+    fd, status_path = tempfile.mkstemp(prefix="bench_status_", suffix=".jsonl")
+    os.close(fd)
+
+    env = dict(os.environ, BENCH_CHILD_STATUS=status_path)
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        stdout=sys.stderr,
+        stderr=sys.stderr,
+    )
+
+    t0 = time.perf_counter()
+    note = None
+    while True:
+        rc = child.poll()
+        if rc is not None:
+            if rc != 0:
+                note = f"child exited rc={rc}"
+            break
+        elapsed = time.perf_counter() - t0
+        try:
+            started = os.path.getsize(status_path) > 0
+        except OSError:
+            started = False
+        if elapsed > init_timeout and not started:
+            note = f"backend_init_timeout_after_{init_timeout:.0f}s (axon tunnel hung)"
+            break
+        if elapsed > total_timeout:
+            note = f"bench_deadline_after_{total_timeout:.0f}s (partial results kept)"
+            break
+        time.sleep(2.0)
+
+    if child.poll() is None:
+        # graceful first: give the TPU runtime a chance to disconnect cleanly
+        # (a hard kill mid-operation can wedge the axon tunnel for later runs)
+        for sig, grace in ((signal.SIGINT, 20), (signal.SIGTERM, 10), (signal.SIGKILL, 5)):
+            try:
+                child.send_signal(sig)
+                child.wait(timeout=grace)
+                break
+            except subprocess.TimeoutExpired:
+                continue
+            except Exception:
+                break
+
+    _emit(_assemble(status_path, note))
+    try:
+        os.unlink(status_path)
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":
+    status = os.environ.get("BENCH_CHILD_STATUS")
     try:
-        main()
+        if status:
+            child_main(status)
+        else:
+            parent_main()
     except Exception:
-        _emit(
-            {
-                "metric": "train_throughput_qm9like_gin_bf16",
-                "value": 0.0,
-                "unit": "graphs/sec/chip",
-                "vs_baseline": 1.0,
-                "error": traceback.format_exc(limit=5),
-            }
-        )
+        if status:
+            _status_write(status, {"kind": "workload", "name": "bench",
+                                   "error": traceback.format_exc(limit=5)})
+        else:
+            _emit(
+                {
+                    "metric": "train_throughput_qm9like_gin_bf16",
+                    "value": 0.0,
+                    "unit": "graphs/sec/chip",
+                    "vs_baseline": 1.0,
+                    "error": traceback.format_exc(limit=5),
+                }
+            )
     sys.exit(0)

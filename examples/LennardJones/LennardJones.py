@@ -8,6 +8,10 @@ forces = -dE/dpos via jax.grad, trained against analytic LJ energies/forces.
 from __future__ import annotations
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
 import numpy as np
 

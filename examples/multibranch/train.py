@@ -12,6 +12,10 @@ CPU dry run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=
 from __future__ import annotations
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 import copy
 
 import numpy as np

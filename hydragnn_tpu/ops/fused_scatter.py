@@ -84,13 +84,14 @@ def _kernel(
     s_starts_ref,  # SMEM [G] scalar-prefetch: per-block sender window start
     r_starts_ref,  # SMEM [G] scalar-prefetch: per-block receiver window start
     h_ref,  # VMEM [N, C] resident input features
-    sl_ref,  # VMEM [1, BE] sender ids local to the block's sender window
-    rl_ref,  # VMEM [1, BE] receiver ids local to the block's receiver window
-    w_ref,  # VMEM [1, BE] or [1, BE, C] edge weights (mask folded in)
+    sl_ref,  # VMEM [1, 1, BE] sender ids local to the block's sender window
+    rl_ref,  # VMEM [1, 1, BE] receiver ids local to the block's receiver window
+    w_ref,  # VMEM [1, 1, BE] or [1, BE, C] edge weights (mask folded in)
     out_ref,  # VMEM [N, C] fp32 accumulator, resident across the grid
     *,
     window: int,
     block_edges: int,
+    w_per_channel: bool,
 ):
     k = pl.program_id(0)
 
@@ -101,21 +102,34 @@ def _kernel(
     s0 = s_starts_ref[k]
     r0 = r_starts_ref[k]
     dtype = h_ref.dtype
+    # bf16 inputs: default MXU passes are exact (one operand is 0/1). fp32
+    # inputs: default precision would round h/msgs to bf16 inside the MXU —
+    # force the full-precision multi-pass mode to keep fp32 parity with the
+    # XLA segment_sum path.
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
 
     hw = h_ref[pl.ds(s0, window), :]  # [W, C]
-    sl = sl_ref[0, :]  # [BE]
+    sl = sl_ref[0, 0, :]  # [BE]
     lane = jax.lax.broadcasted_iota(jnp.int32, (block_edges, window), 1)
     onehot_s = (lane == sl[:, None]).astype(dtype)
-    msgs = jnp.dot(onehot_s, hw, preferred_element_type=jnp.float32)  # [BE, C]
+    msgs = jnp.dot(
+        onehot_s, hw, preferred_element_type=jnp.float32, precision=prec
+    )  # [BE, C]
 
-    if w_ref.ndim == 3:
+    if w_per_channel:
         msgs = msgs * w_ref[0, :, :].astype(jnp.float32)
     else:
-        msgs = msgs * w_ref[0, :].astype(jnp.float32)[:, None]
+        msgs = msgs * w_ref[0, 0, :].astype(jnp.float32)[:, None]
 
-    rl = rl_ref[0, :]
+    rl = rl_ref[0, 0, :]
     onehot_r = (lane == rl[:, None]).astype(jnp.float32)
-    partial = jnp.dot(onehot_r.T, msgs, preferred_element_type=jnp.float32)  # [W, C]
+    partial = jnp.dot(
+        onehot_r.T, msgs, preferred_element_type=jnp.float32, precision=prec
+    )  # [W, C]
     out_ref[pl.ds(r0, window), :] += partial
 
 
@@ -148,21 +162,27 @@ def _pallas_gather_scatter(
     r_starts, r_local, r_fits = _window_starts(receivers, g, block_edges, window, n)
     fits = jnp.logical_and(s_fits, r_fits)
 
-    if weight.ndim == 2:
+    # TPU tiling rule: the last two dims of every block shape must divide
+    # (8, 128) or equal the array's dims — so per-block 1-D payloads ride a
+    # leading grid axis with the block covering the trailing dims entirely.
+    w_per_channel = weight.ndim == 2
+    if w_per_channel:
         w_blocked = weight.reshape(g, block_edges, c)
         w_spec = pl.BlockSpec((1, block_edges, c), lambda k, *_: (k, 0, 0))
     else:
-        w_blocked = weight.reshape(g, block_edges)
-        w_spec = pl.BlockSpec((1, block_edges), lambda k, *_: (k, 0))
+        w_blocked = weight.reshape(g, 1, block_edges)
+        w_spec = pl.BlockSpec((1, 1, block_edges), lambda k, *_: (k, 0, 0))
 
-    kernel = functools.partial(_kernel, window=window, block_edges=block_edges)
+    kernel = functools.partial(
+        _kernel, window=window, block_edges=block_edges, w_per_channel=w_per_channel
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(g,),
         in_specs=[
             pl.BlockSpec((n, c), lambda k, *_: (0, 0)),  # h resident
-            pl.BlockSpec((1, block_edges), lambda k, *_: (k, 0)),
-            pl.BlockSpec((1, block_edges), lambda k, *_: (k, 0)),
+            pl.BlockSpec((1, 1, block_edges), lambda k, *_: (k, 0, 0)),
+            pl.BlockSpec((1, 1, block_edges), lambda k, *_: (k, 0, 0)),
             w_spec,
         ],
         out_specs=pl.BlockSpec((n, c), lambda k, *_: (0, 0)),  # out resident
@@ -176,8 +196,8 @@ def _pallas_gather_scatter(
         s_starts,
         r_starts,
         h,
-        s_local.reshape(g, block_edges),
-        r_local.reshape(g, block_edges),
+        s_local.reshape(g, 1, block_edges),
+        r_local.reshape(g, 1, block_edges),
         w_blocked,
     )
     return out, fits
@@ -271,7 +291,7 @@ def fused_gather_scatter(
 def _scatter_kernel(
     r_starts_ref,  # SMEM [G] scalar-prefetch: per-block receiver window start
     data_ref,  # VMEM [BE, C] message block
-    rl_ref,  # VMEM [1, BE] receiver ids local to the window
+    rl_ref,  # VMEM [1, 1, BE] receiver ids local to the window
     out_ref,  # VMEM [N, C] fp32 accumulator, resident across the grid
     *,
     window: int,
@@ -284,12 +304,17 @@ def _scatter_kernel(
         out_ref[...] = jnp.zeros_like(out_ref)
 
     r0 = r_starts_ref[k]
-    rl = rl_ref[0, :]
+    rl = rl_ref[0, 0, :]
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if data_ref.dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
     lane = jax.lax.broadcasted_iota(jnp.int32, (block_edges, window), 1)
     onehot_r = (lane == rl[:, None]).astype(jnp.float32)
     partial = jnp.dot(
         onehot_r.T, data_ref[...].astype(jnp.float32),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=prec,
     )
     out_ref[pl.ds(r0, window), :] += partial
 
@@ -311,7 +336,7 @@ def _fused_scatter_fwd(data, segment_ids, num_segments, window, block_edges, int
         grid=(g,),
         in_specs=[
             pl.BlockSpec((block_edges, c), lambda k, *_: (k, 0)),
-            pl.BlockSpec((1, block_edges), lambda k, *_: (k, 0)),
+            pl.BlockSpec((1, 1, block_edges), lambda k, *_: (k, 0, 0)),
         ],
         out_specs=pl.BlockSpec((n, c), lambda k, *_: (0, 0)),
     )
@@ -320,7 +345,7 @@ def _fused_scatter_fwd(data, segment_ids, num_segments, window, block_edges, int
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, c), jnp.float32),
         interpret=interpret,
-    )(r_starts, data, r_local.reshape(g, block_edges))
+    )(r_starts, data, r_local.reshape(g, 1, block_edges))
     ref = lambda: jax.ops.segment_sum(
         data.astype(jnp.float32), segment_ids, num_segments=n
     )

@@ -206,6 +206,103 @@ class Visualizer:
         plt.close(fig)
         return out
 
+    def create_parity_plot_per_node_vector(
+        self, true_values, predicted_values, node_counts, name: str = "vector",
+        component_names=None, filename: str | None = None,
+    ) -> str:
+        """Vector-head parity split per structure-size group (reference
+        ``create_parity_plot_per_node_vector``, visualizer.py:519): one row of
+        component parities per distinct node count, showing size-dependent
+        bias for e.g. forces."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        t = np.asarray(true_values).reshape(len(true_values), -1)
+        p = np.asarray(predicted_values).reshape(len(predicted_values), -1)
+        counts = np.asarray(node_counts, np.int64)
+        sizes = np.repeat(counts, counts)[: len(t)]
+        uniq = np.unique(sizes)[:6]  # cap rows like the reference's grids
+        d = t.shape[1]
+        fig, axes = plt.subplots(
+            len(uniq), d, figsize=(3.2 * d, 3.0 * len(uniq)), squeeze=False
+        )
+        for rr, u in enumerate(uniq):
+            m = sizes == u
+            for c in range(d):
+                ax = axes[rr][c]
+                ax.scatter(t[m, c], p[m, c], s=4, alpha=0.5)
+                lo = min(t[m, c].min(), p[m, c].min())
+                hi = max(t[m, c].max(), p[m, c].max())
+                ax.plot([lo, hi], [lo, hi], "k--", lw=1)
+                cname = (
+                    component_names[c]
+                    if component_names and c < len(component_names)
+                    else f"{name}[{c}]"
+                )
+                ax.set_title(f"{cname}, {u} nodes", fontsize=9)
+        out = os.path.join(self.dir, filename or f"parity_{name}_per_node.png")
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out
+
+    def create_plot_global(
+        self, true_values, predicted_values, output_names=None,
+        filename: str = "parity_global.png",
+    ) -> str:
+        """One figure with every head's parity panel (reference
+        ``create_plot_global``, visualizer.py:722)."""
+        return self.create_parity_plot(
+            true_values, predicted_values, names=output_names, filename=filename
+        )
+
+    def create_plot_global_analysis(
+        self, true_values, predicted_values, output_names=None,
+        filename: str = "global_analysis.png",
+    ) -> str:
+        """Per-head density parity + error histogram + conditional-mean-error
+        grid (reference ``create_plot_global_analysis``, visualizer.py:134 —
+        its hist2d-contour/condmean panels), one row per head."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        n = len(true_values)
+        fig, axes = plt.subplots(n, 3, figsize=(12, 3.6 * n), squeeze=False)
+        for i, (tv, pv) in enumerate(zip(true_values, predicted_values)):
+            t = np.concatenate([np.asarray(s).ravel() for s in tv]) if isinstance(
+                tv, (list, tuple)
+            ) else np.asarray(tv).ravel()
+            p = np.concatenate([np.asarray(s).ravel() for s in pv]) if isinstance(
+                pv, (list, tuple)
+            ) else np.asarray(pv).ravel()
+            name = (
+                output_names[i] if output_names and i < len(output_names) else f"head {i}"
+            )
+            ax = axes[i][0]
+            ax.hexbin(t, p, gridsize=50, mincnt=1, bins="log")
+            lo, hi = min(t.min(), p.min()), max(t.max(), p.max())
+            ax.plot([lo, hi], [lo, hi], "k--", lw=1)
+            ax.set_title(f"{name} density parity", fontsize=10)
+            ax.set_xlabel("true")
+            ax.set_ylabel("predicted")
+            axes[i][1].hist(p - t, bins=50)
+            axes[i][1].set_xlabel(f"{name} error")
+            order = np.argsort(t)
+            nb = max(min(20, len(t) // 10), 1)
+            splits = np.array_split(order, nb)
+            centers = [float(np.mean(t[s])) for s in splits if len(s)]
+            cond = [float(np.mean(np.abs(p[s] - t[s]))) for s in splits if len(s)]
+            axes[i][2].plot(centers, cond, "o-")
+            axes[i][2].set_xlabel("true value")
+            axes[i][2].set_ylabel("mean |error|")
+        out = os.path.join(self.dir, filename)
+        fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out
+
     # reference-name alias (``create_scatter_plots``, visualizer.py:692)
     def create_scatter_plots(self, true_values, predicted_values, output_names=None):
         return self.create_parity_plot(true_values, predicted_values, names=output_names)

@@ -12,6 +12,8 @@ import json
 import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 CONFIG = {
     "Verbosity": {"level": 0},

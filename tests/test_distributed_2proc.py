@@ -30,6 +30,8 @@ def _run_workers(tmp_path, mode: str):
     env["HYDRAGNN_AUTO_PARALLEL"] = "1"
     env["HYDRAGNN_TENSORBOARD"] = "0"
     env.pop("JAX_NUM_PROCESSES", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
 
     procs = [
         subprocess.Popen(

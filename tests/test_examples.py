@@ -13,6 +13,7 @@ ENV = dict(
     os.environ,
     JAX_PLATFORMS="cpu",
     XLA_FLAGS=os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+    PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
 )
 
 

@@ -112,6 +112,45 @@ def test_visualizer_extended_plots(tmp_path):
     # reference-name alias
     assert os.path.exists(viz.create_scatter_plots([t], [p], ["energy"]))
 
+    # global-analysis grid + per-size vector parity (visualizer.py:134,519,722)
+    assert os.path.exists(viz.create_plot_global([t], [p], ["energy"]))
+    assert os.path.exists(viz.create_plot_global_analysis([t], [p], ["energy"]))
+    tv = rng.normal(size=(sum(counts), 3))
+    pv = tv + 0.05 * rng.normal(size=(sum(counts), 3))
+    assert os.path.exists(
+        viz.create_parity_plot_per_node_vector(tv, pv, counts, name="forces")
+    )
+
+
+def test_unscale_features_by_num_nodes():
+    """Extensive node targets scaled by 1/num_nodes are unscaled per sample
+    (reference postprocess.py:29-54)."""
+    import numpy as np
+
+    from hydragnn_tpu.postprocess.postprocess import (
+        unscale_features_by_num_nodes,
+        unscale_features_by_num_nodes_config,
+    )
+
+    nodes = [2, 4]
+    true = [[np.ones(2), np.ones(4)]]
+    pred = [[np.full(2, 0.5), np.full(4, 0.5)]]
+    t2, p2 = unscale_features_by_num_nodes([true, pred], [0], nodes)
+    assert np.allclose(t2[0][0], 2.0) and np.allclose(t2[0][1], 4.0)
+    assert np.allclose(p2[0][1], 2.0)
+
+    cfg = {
+        "NeuralNetwork": {
+            "Variables_of_interest": {
+                "output_names": ["energy_scaled_num_nodes"],
+                "denormalize_output": True,
+            }
+        }
+    }
+    true = [[np.ones(2), np.ones(4)]]
+    out = unscale_features_by_num_nodes_config(cfg, [true], nodes)
+    assert np.allclose(out[0][0][1], 4.0)
+
 
 def test_run_prediction_dump_testdata(tmp_path, monkeypatch):
     """HYDRAGNN_DUMP_TESTDATA=1 writes per-rank test pickles (reference
