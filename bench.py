@@ -207,6 +207,12 @@ def _run_workload(
     from hydragnn_tpu.models import create_model_config
     from hydragnn_tpu.train import create_train_state, select_optimizer
 
+    t_wl = time.perf_counter()
+
+    def note(msg: str) -> None:
+        print(f"[bench:{name}] {time.perf_counter() - t_wl:6.1f}s {msg}",
+              file=sys.stderr, flush=True)
+
     cfg = update_config(cfg, samples)
     model = create_model_config(cfg)
     optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
@@ -216,10 +222,15 @@ def _run_workload(
     host_batches = list(loader)
     collate_s = time.perf_counter() - t0
     batches = [jax.tree.map(jnp.asarray, b) for b in host_batches]
+    jax.block_until_ready(batches[0])
+    note(f"{len(batches)} batches staged on device")
     state = create_train_state(model, optimizer, batches[0])
+    jax.block_until_ready(jax.tree.leaves(state.params)[0])
+    note("params initialized")
     train_step = make_step(model, optimizer)
 
     state, _ = _time_steps(train_step, state, batches, warmup)
+    note("warmup (compile) done")
     profile_dir = os.getenv("BENCH_PROFILE")
     if profile_dir:
         with jax.profiler.trace(os.path.join(profile_dir, name)):
@@ -227,6 +238,7 @@ def _run_workload(
     else:
         state, dt = _time_steps(train_step, state, batches, max(bench_steps, 1))
     bench_steps = max(bench_steps, 1)
+    note(f"{bench_steps} timed steps done ({1e3 * dt / bench_steps:.1f} ms/step)")
 
     n_chips = jax.device_count()
     graphs_per_sec = bench_steps * batch_size / dt

@@ -171,9 +171,11 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     first = train_samples[0] if len(train_samples) else None
     arch["num_nodes"] = int(first.num_nodes) if first is not None else None
     graph_size_variable = len({s.num_nodes for s in train_samples}) > 1
-    env_var = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")
+    from ..utils import flags
+
+    env_var = flags.get(flags.USE_VARIABLE_GRAPH_SIZE)
     if env_var is not None:
-        graph_size_variable = bool(int(env_var))
+        graph_size_variable = env_var
     arch["graph_size_variable"] = graph_size_variable
     if graph_size_variable:
         for branch in arch["output_heads"].get("node", []):

@@ -18,6 +18,7 @@ Padding convention:
 from __future__ import annotations
 
 import math
+import os
 from typing import Iterable, Sequence
 
 import numpy as np
@@ -427,6 +428,29 @@ class PrefetchLoader:
 
         return jax.tree.map(jax.device_put, batch)
 
+    @staticmethod
+    def _pin_worker() -> None:
+        """Core-affinity pinning for collate workers (the reference
+        HydraDataLoader's HYDRAGNN_AFFINITY/_WIDTH/_OFFSET scheme,
+        ``preprocess/load_data.py:121-136``): each worker thread gets its own
+        ``width`` cores starting at ``offset``. Linux-only; silent no-op
+        elsewhere."""
+        from ..utils import flags
+
+        if not flags.get(flags.AFFINITY) or not hasattr(os, "sched_setaffinity"):
+            return
+        width = max(1, flags.get(flags.AFFINITY_WIDTH))
+        offset = flags.get(flags.AFFINITY_OFFSET)
+        idx = next(PrefetchLoader._pin_counter)  # atomic under the GIL
+        ncpu = os.cpu_count() or 1
+        cores = {(offset + idx * width + k) % ncpu for k in range(width)}
+        try:
+            os.sched_setaffinity(0, cores)
+        except OSError:
+            pass
+
+    _pin_counter = __import__("itertools").count()
+
     def _iter_pooled(self):
         """Order-preserving multi-worker collate over the epoch's batch plan,
         at most ``depth`` finished batches buffered ahead."""
@@ -434,7 +458,9 @@ class PrefetchLoader:
         from concurrent.futures import ThreadPoolExecutor
 
         plan = self.loader.batch_plan()
-        with ThreadPoolExecutor(max_workers=self.workers) as ex:
+        with ThreadPoolExecutor(
+            max_workers=self.workers, initializer=self._pin_worker
+        ) as ex:
             pending: deque = deque()
             it = iter(plan)
             try:
@@ -474,6 +500,7 @@ class PrefetchLoader:
             return False
 
         def worker():
+            self._pin_worker()
             try:
                 for b in self.loader:
                     if not put(self._transfer(b)):

@@ -66,9 +66,9 @@ def _pairs_within(
 
     # large systems: the native multithreaded cell list (the reference's
     # vesin role) when built; HYDRAGNN_NATIVE=0 forces the numpy path
-    import os
+    from ..utils import flags
 
-    if os.getenv("HYDRAGNN_NATIVE", "1") != "0":
+    if flags.get(flags.NATIVE):
         from ..native import pairs_within_native
 
         native = pairs_within_native(query, points, radius)

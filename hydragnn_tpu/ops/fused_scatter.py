@@ -56,10 +56,9 @@ _VMEM_RESIDENT_LIMIT = 10 * 1024 * 1024
 
 
 def _flag_enabled() -> bool | None:
-    v = os.getenv("HYDRAGNN_FUSED_SCATTER")
-    if v is None:
-        return None
-    return v not in ("0", "false", "False")
+    from ..utils import flags
+
+    return flags.get(flags.FUSED_SCATTER)
 
 
 def _auto_enabled() -> bool:

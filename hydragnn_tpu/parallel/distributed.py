@@ -75,8 +75,11 @@ def _first_host_from_nodelist() -> str | None:
 
 def _port_from_job_id(default: int = 8889) -> int:
     """Deterministic port derived from the job id (reference :171-185)."""
-    if os.getenv("HYDRAGNN_MASTER_PORT"):
-        return int(os.environ["HYDRAGNN_MASTER_PORT"])
+    from ..utils import flags
+
+    port = flags.get(flags.MASTER_PORT)
+    if port is not None:
+        return port
     job = os.getenv("SLURM_JOB_ID") or os.getenv("LSB_JOBID") or os.getenv("PBS_JOBID")
     if job:
         digits = re.sub(r"\D", "", job) or "0"
@@ -96,7 +99,9 @@ def setup_ddp(verbosity: int = 0) -> tuple[int, int]:
     if jax.process_count() > 1:  # already initialized
         return jax.process_count(), jax.process_index()
 
-    coordinator = os.getenv("HYDRAGNN_MASTER_ADDR") or _first_host_from_nodelist()
+    from ..utils import flags
+
+    coordinator = flags.get(flags.MASTER_ADDR) or _first_host_from_nodelist()
     kwargs = {}
     if coordinator:
         kwargs["coordinator_address"] = f"{coordinator}:{_port_from_job_id()}"

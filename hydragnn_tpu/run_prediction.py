@@ -85,9 +85,9 @@ def run_prediction(config_source, state: TrainState, model=None, samples: Sequen
         true_values = [_allgather_ragged(t) for t in true_values]
         predicted_values = [_allgather_ragged(p) for p in predicted_values]
 
-    import os as _os
+    from .utils import flags
 
-    if int(_os.getenv("HYDRAGNN_DUMP_TESTDATA", "0")) == 1:
+    if flags.get(flags.DUMP_TESTDATA):
         # reference dumps per-rank test pickles (train_validate_test.py:908)
         import pickle
 

@@ -18,6 +18,7 @@ from .preprocess.load_data import apply_variables_of_interest, dataset_loading_a
 from .train.loop import train_validate_test
 from .train.optimizer import select_optimizer
 from .train.step import create_train_state, resolve_precision
+from .utils import flags
 from .utils import tracer as tr
 from .utils.print_utils import print_distributed, setup_log
 
@@ -26,6 +27,7 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     config = load_config(config_source)
     verbosity = config.get("Verbosity", {}).get("level", 0)
     training_cfg = config.get("NeuralNetwork", {}).get("Training", {})
+    flags.warn_unknown()  # typo'd / subsumed HYDRAGNN_* vars warn, not vanish
 
     # persistent XLA compile cache: reruns/HPO trials skip the 20-40 s TPU
     # compile (HYDRAGNN_COMPILE_CACHE=0 disables)
@@ -51,9 +53,7 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     try:
         import jax
 
-        will_mesh = (
-            os.getenv("HYDRAGNN_AUTO_PARALLEL", "1") != "0" and len(jax.devices()) > 1
-        )
+        will_mesh = flags.get(flags.AUTO_PARALLEL) and len(jax.devices()) > 1
     except Exception:
         pass
     if will_mesh and training_cfg.get("pad_buckets"):
@@ -117,14 +117,21 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             config["NeuralNetwork"].get("Architecture", {}).get("edge_sharding")
         )
         if (
-            os.getenv("HYDRAGNN_AUTO_PARALLEL", "1") != "0"
+            flags.get(flags.AUTO_PARALLEL)
             and n_dev > 1
             and (edge_mode or len(train_loader) >= n_local)
         ):
             from .parallel import make_mesh, shard_state
 
             mesh = make_mesh()
-            param_mode = "fsdp" if os.getenv("HYDRAGNN_USE_FSDP") == "1" else "replicated"
+            # FSDP_STRATEGY maps the reference's torch strategies
+            # (distributed.py:435-437): NO_SHARD -> replicated, everything
+            # else -> param+opt sharding over the data axis
+            use_fsdp = flags.get(flags.USE_FSDP)
+            strategy = flags.get(flags.FSDP_STRATEGY)
+            param_mode = (
+                "fsdp" if use_fsdp and strategy != "NO_SHARD" else "replicated"
+            )
             state = shard_state(state, mesh, param_mode=param_mode)
             # publish the mesh for trace-time consumers (ring attention)
             from .parallel.ring_attention import set_global_mesh
@@ -132,7 +139,7 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             set_global_mesh(mesh)
             print_distributed(verbosity, f"auto-parallel: {n_dev}-device data mesh ({param_mode})")
     except Exception as e:
-        if os.getenv("HYDRAGNN_USE_FSDP") == "1":
+        if flags.get(flags.USE_FSDP):
             raise  # explicit sharding request: fail fast, don't downgrade
         print_distributed(verbosity, f"auto-parallel disabled ({e})")
         mesh = None
@@ -142,7 +149,7 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     # writer is the fallback since torch ships in most reference installs.
     # HYDRAGNN_TENSORBOARD=0 disables.
     writer = None
-    if os.getenv("HYDRAGNN_TENSORBOARD", "1") != "0":
+    if flags.get(flags.TENSORBOARD):
         try:
             import jax
 
@@ -167,8 +174,10 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     # load_data.py:94-204): collate + host->device transfer run a couple of
     # batches ahead of the step loop. Training.prefetch / HYDRAGNN_PREFETCH
     # set the depth; 0 disables.
-    depth = int(os.getenv("HYDRAGNN_PREFETCH", training_cfg.get("prefetch", 2)))
-    workers = int(training_cfg.get("num_workers", 1))
+    depth = flags.get(flags.PREFETCH, default=int(training_cfg.get("prefetch", 2)))
+    workers = flags.get(
+        flags.NUM_WORKERS, default=int(training_cfg.get("num_workers", 1))
+    )
     if depth > 0:
         from .graphs.batching import PrefetchLoader
 

@@ -23,6 +23,7 @@ import numpy as np
 from ..graphs.batching import GraphLoader
 from ..models.base import HydraModel
 from ..utils.print_utils import print_distributed, iterate_tqdm
+from ..utils import flags
 from ..utils import tracer as tr
 from .checkpoint import Checkpoint, EarlyStopping
 from .optimizer import ReduceLROnPlateau, get_learning_rate, set_learning_rate
@@ -31,9 +32,9 @@ from .step import TrainState, make_eval_step, make_train_step, resolve_precision
 
 def _max_num_batches(loader) -> int:
     n = len(loader)
-    cap = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
+    cap = flags.get(flags.MAX_NUM_BATCH)
     if cap is not None:
-        n = min(n, int(cap))
+        n = min(n, cap)
     return n
 
 
@@ -260,17 +261,39 @@ def train_validate_test(
         if training.get("EarlyStopping", False)
         else None
     )
-    skip_valtest = os.getenv("HYDRAGNN_VALTEST", "1") == "0"
+    skip_valtest = not flags.get(flags.VALTEST)
     # a dataset too small (or perc_train=1.0) can leave val/test empty —
     # train-only in that case instead of crashing
     if len(val_loader.samples) == 0 or len(test_loader.samples) == 0:
         skip_valtest = True
 
+    # HYDRAGNN_TRACE_LEVEL>=1: profile the first epoch (reference wraps the
+    # loop in torch.profiler at TRACE_LEVEL, train_validate_test.py:324,675)
+    trace_level = flags.get(flags.TRACE_LEVEL)
+    profiling = False
+    if trace_level >= 1:
+        try:
+            import jax
+
+            jax.profiler.start_trace(os.path.join("./logs", log_name, "profile"))
+            profiling = True
+        except Exception:
+            pass
+
     for epoch in range(num_epoch):
+        os.environ["HYDRAGNN_EPOCH"] = str(epoch)  # exported for tools (reference :316)
         train_loader.set_epoch(epoch)
         state, train_loss, train_tasks = train_epoch(
             train_step, state, train_loader, verbosity, mesh=mesh, put_fn=put_fn
         )
+        if profiling and epoch == 0:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            profiling = False
 
         if skip_valtest:
             print_distributed(
@@ -320,6 +343,14 @@ def train_validate_test(
         if walltime_check is not None and walltime_check():
             print_distributed(verbosity, f"Walltime guard tripped at epoch {epoch}")
             break
+
+    if profiling:  # num_epoch == 0 or early break during the profiled epoch
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
 
     return state
 

@@ -20,8 +20,10 @@ def enable_compile_cache(default_dir: str = "./.jax_cache") -> str | None:
     """Idempotently point jax at a persistent compilation cache directory.
     Returns the directory, or None when disabled/unavailable."""
     global _enabled
-    setting = os.getenv("HYDRAGNN_COMPILE_CACHE", default_dir)
-    if setting in ("0", "false", "False", ""):
+    from . import flags
+
+    setting = flags.get(flags.COMPILE_CACHE, default=default_dir)
+    if setting in ("0", "false", "False", "", None):
         return None
     if _enabled:
         return setting

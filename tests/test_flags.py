@@ -1,0 +1,100 @@
+"""Typed HYDRAGNN_* flag registry (reference's ~20 env flags, SURVEY §5)."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.utils import flags
+
+
+def test_typed_accessors(monkeypatch):
+    monkeypatch.delenv("HYDRAGNN_VALTEST", raising=False)
+    assert flags.get(flags.VALTEST) is True
+    monkeypatch.setenv("HYDRAGNN_VALTEST", "0")
+    assert flags.get(flags.VALTEST) is False
+
+    monkeypatch.setenv("HYDRAGNN_MAX_NUM_BATCH", "7")
+    assert flags.get(flags.MAX_NUM_BATCH) == 7
+    monkeypatch.delenv("HYDRAGNN_MAX_NUM_BATCH")
+    assert flags.get(flags.MAX_NUM_BATCH) is None
+
+    # caller default beats registry default only when env is unset
+    monkeypatch.delenv("HYDRAGNN_PREFETCH", raising=False)
+    assert flags.get(flags.PREFETCH, default=3) == 3
+    monkeypatch.setenv("HYDRAGNN_PREFETCH", "5")
+    assert flags.get(flags.PREFETCH, default=3) == 5
+
+
+def test_unknown_flag_warns(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_TOTALLY_MADE_UP", "1")
+    flags._warned.discard("HYDRAGNN_TOTALLY_MADE_UP")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        bad = flags.warn_unknown()
+    assert "HYDRAGNN_TOTALLY_MADE_UP" in bad
+    assert any("HYDRAGNN_TOTALLY_MADE_UP" in str(w.message) for w in rec)
+
+
+def test_subsumed_flag_warns_and_returns_default(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_AGGR_BACKEND", "mpi")
+    flag = flags._REGISTRY["HYDRAGNN_AGGR_BACKEND"]
+    flags._warned.discard(flag.name)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert flags.get(flag) is None
+    assert any("all-reduce" in str(w.message) for w in rec)
+
+
+def test_describe_lists_every_flag():
+    out = flags.describe()
+    for name in ("HYDRAGNN_VALTEST", "HYDRAGNN_MAX_NUM_BATCH",
+                 "HYDRAGNN_FUSED_SCATTER", "HYDRAGNN_AGGR_BACKEND"):
+        assert name in out
+
+
+def test_max_num_batch_flag_caps_epoch(monkeypatch):
+    """MAX_NUM_BATCH reaches the loop (reference train_validate_test.py:179)."""
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.graphs.graph import GraphSample
+    from hydragnn_tpu.train.loop import _max_num_batches
+
+    rng = np.random.default_rng(0)
+    samples = []
+    for _ in range(12):
+        na = 4
+        samples.append(GraphSample(
+            x=rng.normal(size=(na, 1)).astype(np.float32),
+            pos=rng.uniform(0, 3, (na, 3)),
+            senders=np.array([0, 1]), receivers=np.array([1, 0]),
+            edge_shifts=np.zeros((2, 3)),
+            graph_y=np.zeros(1), node_y=np.zeros((na, 1))))
+    loader = GraphLoader(samples, 2)
+    assert _max_num_batches(loader) == 6
+    monkeypatch.setenv("HYDRAGNN_MAX_NUM_BATCH", "2")
+    assert _max_num_batches(loader) == 2
+
+
+def test_affinity_pinning_smoke(monkeypatch):
+    """AFFINITY pins collate workers (reference load_data.py:121-136) —
+    smoke: a pinned worker thread ends up with a 1-core affinity mask."""
+    if not hasattr(os, "sched_setaffinity"):
+        pytest.skip("no sched_setaffinity on this platform")
+    import threading
+
+    from hydragnn_tpu.graphs.batching import PrefetchLoader
+
+    monkeypatch.setenv("HYDRAGNN_AFFINITY", "1")
+    monkeypatch.setenv("HYDRAGNN_AFFINITY_WIDTH", "1")
+    monkeypatch.setenv("HYDRAGNN_AFFINITY_OFFSET", "0")
+    seen = {}
+
+    def probe():
+        PrefetchLoader._pin_worker()
+        seen["mask"] = os.sched_getaffinity(0)
+
+    t = threading.Thread(target=probe)
+    t.start()
+    t.join()
+    assert len(seen["mask"]) == 1
