@@ -17,6 +17,7 @@ Padding convention:
 
 from __future__ import annotations
 
+import itertools
 import math
 import os
 from typing import Iterable, Sequence
@@ -411,6 +412,7 @@ class PrefetchLoader:
         self.depth = max(1, int(depth))
         self.device_put = device_put
         self.workers = max(1, int(workers))
+        self._reset_pins()
         # delegate loader state the epoch loop touches
         self.samples = getattr(loader, "samples", [])
         self.pad = getattr(loader, "pad", None)
@@ -428,20 +430,21 @@ class PrefetchLoader:
 
         return jax.tree.map(jax.device_put, batch)
 
-    @staticmethod
-    def _pin_worker() -> None:
+    def _pin_worker(self) -> None:
         """Core-affinity pinning for collate workers (the reference
         HydraDataLoader's HYDRAGNN_AFFINITY/_WIDTH/_OFFSET scheme,
-        ``preprocess/load_data.py:121-136``): each worker thread gets its own
-        ``width`` cores starting at ``offset``. Linux-only; silent no-op
-        elsewhere."""
+        ``preprocess/load_data.py:121-136``): worker i of a pool owns cores
+        [offset + i*width, offset + (i+1)*width) — stable across epochs
+        because the counter resets per pool (``_reset_pins``). Wraps mod
+        ncpu only when workers*width exceeds the machine. Linux-only;
+        silent no-op elsewhere."""
         from ..utils import flags
 
         if not flags.get(flags.AFFINITY) or not hasattr(os, "sched_setaffinity"):
             return
         width = max(1, flags.get(flags.AFFINITY_WIDTH))
         offset = flags.get(flags.AFFINITY_OFFSET)
-        idx = next(PrefetchLoader._pin_counter)  # atomic under the GIL
+        idx = next(self._pin_counter)  # itertools.count: atomic under the GIL
         ncpu = os.cpu_count() or 1
         cores = {(offset + idx * width + k) % ncpu for k in range(width)}
         try:
@@ -449,7 +452,8 @@ class PrefetchLoader:
         except OSError:
             pass
 
-    _pin_counter = __import__("itertools").count()
+    def _reset_pins(self) -> None:
+        self._pin_counter = itertools.count()
 
     def _iter_pooled(self):
         """Order-preserving multi-worker collate over the epoch's batch plan,
@@ -458,6 +462,7 @@ class PrefetchLoader:
         from concurrent.futures import ThreadPoolExecutor
 
         plan = self.loader.batch_plan()
+        self._reset_pins()
         with ThreadPoolExecutor(
             max_workers=self.workers, initializer=self._pin_worker
         ) as ex:
@@ -498,6 +503,8 @@ class PrefetchLoader:
                 except queue.Full:
                     continue
             return False
+
+        self._reset_pins()
 
         def worker():
             self._pin_worker()

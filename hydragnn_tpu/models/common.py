@@ -60,6 +60,16 @@ def masked_rmse(pred: Array, target: Array, mask: Array) -> Array:
     return jnp.sqrt(masked_mse(pred, target, mask) + 1e-16)
 
 
+def masked_smooth_l1(pred: Array, target: Array, mask: Array) -> Array:
+    """torch SmoothL1Loss (beta=1): 0.5 d^2 for |d|<1 else |d|-0.5, mean over
+    real rows (reference loss_function_selection, model.py:54-55)."""
+    mask = mask.reshape(mask.shape[0], *([1] * (pred.ndim - 1)))
+    d = jnp.abs(pred - target)
+    huber = jnp.where(d < 1.0, 0.5 * d**2, d - 0.5) * mask
+    n_real = jnp.maximum(mask.sum(), 1.0)
+    return huber.sum() / (n_real * pred.shape[-1])
+
+
 def masked_gaussian_nll(pred: Array, target: Array, mask: Array, var: Array) -> Array:
     """torch.nn.GaussianNLLLoss semantics: 0.5*(log(var) + (x-mu)^2/var),
     var clamped below at eps, mean reduction over real rows."""
@@ -75,6 +85,7 @@ _LOSSES = {
     "mse": masked_mse,
     "mae": masked_mae,
     "rmse": masked_rmse,
+    "smooth_l1": masked_smooth_l1,
 }
 
 

@@ -36,7 +36,6 @@ elsewhere.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp

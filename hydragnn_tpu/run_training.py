@@ -128,7 +128,13 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             # (distributed.py:435-437): NO_SHARD -> replicated, everything
             # else -> param+opt sharding over the data axis
             use_fsdp = flags.get(flags.USE_FSDP)
-            strategy = flags.get(flags.FSDP_STRATEGY)
+            strategy = str(flags.get(flags.FSDP_STRATEGY)).upper()
+            if use_fsdp:
+                known = {"FULL_SHARD", "SHARD_GRAD_OP", "HYBRID_SHARD", "NO_SHARD"}
+                if strategy not in known:
+                    raise ValueError(
+                        f"HYDRAGNN_FSDP_STRATEGY={strategy!r} not one of {sorted(known)}"
+                    )
             param_mode = (
                 "fsdp" if use_fsdp and strategy != "NO_SHARD" else "replicated"
             )

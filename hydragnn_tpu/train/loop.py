@@ -269,16 +269,19 @@ def train_validate_test(
 
     # HYDRAGNN_TRACE_LEVEL>=1: profile the first epoch (reference wraps the
     # loop in torch.profiler at TRACE_LEVEL, train_validate_test.py:324,675)
-    trace_level = flags.get(flags.TRACE_LEVEL)
-    profiling = False
-    if trace_level >= 1:
+    def _profiler(action: str) -> bool:
         try:
             import jax
 
-            jax.profiler.start_trace(os.path.join("./logs", log_name, "profile"))
-            profiling = True
+            if action == "start":
+                jax.profiler.start_trace(os.path.join("./logs", log_name, "profile"))
+            else:
+                jax.profiler.stop_trace()
+            return True
         except Exception:
-            pass
+            return False
+
+    profiling = flags.get(flags.TRACE_LEVEL) >= 1 and _profiler("start")
 
     for epoch in range(num_epoch):
         os.environ["HYDRAGNN_EPOCH"] = str(epoch)  # exported for tools (reference :316)
@@ -287,12 +290,7 @@ def train_validate_test(
             train_step, state, train_loader, verbosity, mesh=mesh, put_fn=put_fn
         )
         if profiling and epoch == 0:
-            try:
-                import jax
-
-                jax.profiler.stop_trace()
-            except Exception:
-                pass
+            _profiler("stop")
             profiling = False
 
         if skip_valtest:
@@ -345,12 +343,7 @@ def train_validate_test(
             break
 
     if profiling:  # num_epoch == 0 or early break during the profiled epoch
-        try:
-            import jax
-
-            jax.profiler.stop_trace()
-        except Exception:
-            pass
+        _profiler("stop")
 
     return state
 

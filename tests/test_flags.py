@@ -88,13 +88,26 @@ def test_affinity_pinning_smoke(monkeypatch):
     monkeypatch.setenv("HYDRAGNN_AFFINITY", "1")
     monkeypatch.setenv("HYDRAGNN_AFFINITY_WIDTH", "1")
     monkeypatch.setenv("HYDRAGNN_AFFINITY_OFFSET", "0")
+    pl = PrefetchLoader(loader=[], depth=1, device_put=False)
     seen = {}
 
-    def probe():
-        PrefetchLoader._pin_worker()
-        seen["mask"] = os.sched_getaffinity(0)
+    def probe(slot):
+        pl._pin_worker()
+        seen[slot] = os.sched_getaffinity(0)
 
-    t = threading.Thread(target=probe)
+    ts = [threading.Thread(target=probe, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(len(m) == 1 for m in seen.values())
+    # distinct workers of one pool land on distinct cores
+    if (os.cpu_count() or 1) >= 2:
+        assert seen[0] != seen[1]
+    # a fresh pool starts over at the first core (no drift across epochs) —
+    # probe in a throwaway thread so the test process itself stays unpinned
+    pl._reset_pins()
+    t = threading.Thread(target=probe, args=("fresh",))
     t.start()
     t.join()
-    assert len(seen["mask"]) == 1
+    assert seen["fresh"] == {0}

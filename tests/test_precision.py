@@ -1,0 +1,82 @@
+"""Precision policy plumbing (reference ``tests/test_precision_control.py`` +
+``train_validate_test.py:43-71`` PRECISION_MAP): fp32 master params with
+cast-to-compute, every alias resolving, fp64 opt-in."""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.train.step import (
+    PRECISION_MAP,
+    _cast_floats,
+    create_train_state,
+    make_train_step,
+    resolve_precision,
+)
+
+
+def test_precision_aliases_resolve():
+    # reference PRECISION_MAP aliases (train_validate_test.py:43-58)
+    for name in ("fp32", "float32", "fp64", "float64", "bf16", "bfloat16"):
+        assert resolve_precision(name) is not None
+    assert resolve_precision("bf16") == resolve_precision("bfloat16")
+    assert resolve_precision("fp32") == resolve_precision("float32")
+
+
+def test_unknown_precision_raises():
+    with pytest.raises(ValueError, match="fp32"):
+        resolve_precision("fp16_but_wrong")
+
+
+def test_cast_floats_only_touches_floats():
+    tree = {
+        "w": jnp.ones((2, 2), jnp.float32),
+        "ids": jnp.arange(3, dtype=jnp.int32),
+        "flag": np.bool_(True),
+    }
+    out = _cast_floats(tree, jnp.bfloat16)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["ids"].dtype == jnp.int32
+
+
+def _tiny_setup():
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train import select_optimizer
+    from test_config import CI_CONFIG
+
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=16, seed=0)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    opt = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    batch = next(iter(GraphLoader(samples, 8)))
+    batch = jax.tree.map(jnp.asarray, batch)
+    return model, opt, batch
+
+
+def test_bf16_compute_keeps_fp32_master_params():
+    model, opt, batch = _tiny_setup()
+    state = create_train_state(model, opt, batch)
+    step = make_train_step(model, opt, compute_dtype=jnp.bfloat16)
+    state2, metrics = step(state, batch)
+    # master params and gradients-applied params stay fp32
+    for leaf in jax.tree.leaves(state2.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
+    # loss is finite and fp32
+    assert metrics["loss"].dtype == jnp.float32
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_bf16_and_fp32_losses_agree_roughly():
+    model, opt, batch = _tiny_setup()
+    state = create_train_state(model, opt, batch)
+    l32 = float(make_train_step(model, opt, jnp.float32)(state, batch)[1]["loss"])
+    l16 = float(make_train_step(model, opt, jnp.bfloat16)(state, batch)[1]["loss"])
+    assert l16 == pytest.approx(l32, rel=0.05)

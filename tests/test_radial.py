@@ -1,0 +1,134 @@
+"""Radial basis / cutoff property tests (reference
+``tests/test_radial_transforms.py`` — Bessel/Chebyshev/Gaussian bases and
+cutoff windows shared by SchNet/PNAPlus/DimeNet/PaiNN/MACE)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.models.radial import (
+    BesselBasis,
+    ChebyshevBasis,
+    GaussianSmearing,
+    cosine_cutoff,
+    polynomial_cutoff,
+    polynomial_envelope,
+    shifted_softplus,
+    sinc_expansion,
+)
+
+CUTOFF = 5.0
+
+
+def test_cosine_cutoff_window():
+    d = jnp.linspace(0.0, 2 * CUTOFF, 101)
+    c = cosine_cutoff(d, CUTOFF)
+    assert float(c[0]) == pytest.approx(1.0)
+    # zero at and beyond the cutoff
+    assert np.all(np.asarray(c)[d >= CUTOFF] == 0.0)
+    # monotone non-increasing inside
+    inside = np.asarray(c)[np.asarray(d) <= CUTOFF]
+    assert np.all(np.diff(inside) <= 1e-7)
+    assert np.all((np.asarray(c) >= 0) & (np.asarray(c) <= 1))
+
+
+@pytest.mark.parametrize("p", [4, 6])
+def test_polynomial_cutoff_smooth_to_zero(p):
+    d = jnp.linspace(0.0, CUTOFF, 201)
+    f = polynomial_cutoff(d, CUTOFF, p=p)
+    assert float(f[0]) == pytest.approx(1.0)
+    assert float(f[-1]) == pytest.approx(0.0, abs=1e-6)
+    # derivative also vanishes at the cutoff (p-th order continuity)
+    g = jax.grad(lambda x: polynomial_cutoff(x, CUTOFF, p=p).sum())
+    assert float(g(jnp.array([CUTOFF - 1e-4]))[0]) == pytest.approx(0.0, abs=1e-2)
+    assert float(polynomial_cutoff(jnp.array([2 * CUTOFF]), CUTOFF, p=p)[0]) == 0.0
+
+
+def test_polynomial_envelope_boundary():
+    # u(x)*x -> value and first two derivatives vanish at x=1 (DimeNet)
+    def f(x):
+        return polynomial_envelope(x, 5) * x
+
+    # approach from inside; exactly at 1.0 the where() already clamps to 0.
+    # the first nonzero derivative is the 3rd (|f'''(1)| = 336), so at
+    # distance e from the boundary: f ~ 56 e^3, f' ~ 168 e^2, f'' ~ 336 e
+    eps = 1e-3
+    for order, scale in ((0, eps**3), (1, eps**2), (2, eps)):
+        fn = f
+        for _ in range(order):
+            fn = jax.grad(fn)
+        assert float(fn(jnp.float64(1.0 - eps) if jax.config.jax_enable_x64
+                        else jnp.float32(1.0 - eps))) == pytest.approx(
+            0.0, abs=400 * scale + 1e-4)
+
+
+def test_bessel_basis_shapes_and_envelope():
+    basis = BesselBasis(num_radial=6, cutoff=CUTOFF)
+    d = jnp.linspace(0.1, CUTOFF * 1.2, 40)
+    params = basis.init(jax.random.PRNGKey(0), d)
+    out = basis.apply(params, d)
+    assert out.shape == (40, 6)
+    # outside the cutoff the envelope kills every channel
+    outside = np.asarray(out)[np.asarray(d) >= CUTOFF]
+    assert np.allclose(outside, 0.0)
+    # frequencies initialize at n*pi
+    freq = np.asarray(jax.tree.leaves(params)[0]).ravel()
+    assert np.allclose(sorted(freq), np.arange(1, 7) * math.pi)
+
+
+def test_gaussian_smearing_grid():
+    sm = GaussianSmearing(start=0.0, stop=CUTOFF, num_gaussians=50)
+    d = jnp.array([0.0, 1.0, 2.5, CUTOFF])
+    out = sm.apply({}, d)
+    assert out.shape == (4, 50)
+    # each distance peaks at its nearest grid center
+    centers = np.linspace(0, CUTOFF, 50)
+    peak = centers[np.argmax(np.asarray(out), axis=1)]
+    assert np.allclose(peak, np.asarray(d), atol=CUTOFF / 49)
+    assert np.all(np.asarray(out) <= 1.0 + 1e-6)
+
+
+def test_sinc_expansion_zero_distance_limit():
+    # sin(n pi d / rc)/d -> n pi / rc as d -> 0 (PaiNN): must be finite
+    out0 = sinc_expansion(jnp.array([0.0]), 8, CUTOFF)
+    expect = np.arange(1, 9) * math.pi / CUTOFF
+    assert np.allclose(np.asarray(out0)[0], expect, rtol=1e-6)
+    out = sinc_expansion(jnp.array([1e-6]), 8, CUTOFF)
+    assert np.allclose(np.asarray(out)[0], expect, rtol=1e-3)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_chebyshev_recurrence():
+    basis = ChebyshevBasis(num_basis=8, cutoff=CUTOFF)
+    d = jnp.linspace(0.0, CUTOFF, 33)
+    out = np.asarray(basis.apply({}, d))
+    assert out.shape == (33, 8)
+    x = np.clip(2.0 * np.asarray(d) / CUTOFF - 1.0, -1, 1)
+    # T_n(cos t) = cos(n t)
+    t = np.arccos(x)
+    for n in range(8):
+        assert np.allclose(out[:, n], np.cos(n * t), atol=1e-5), n
+
+
+def test_shifted_softplus_properties():
+    assert float(shifted_softplus(jnp.float32(0.0))) == pytest.approx(0.0)
+    x = jnp.linspace(-5, 5, 21)
+    y = np.asarray(shifted_softplus(x))
+    assert np.all(np.diff(y) > 0)  # strictly increasing
+    assert y[-1] == pytest.approx(5.0 - math.log(2.0), abs=1e-2)
+
+
+def test_bases_differentiable_through_grad():
+    """Force training differentiates through every basis — no NaN at d=0
+    (double-grad safety, SURVEY §7 hard part (d))."""
+    def energy(d):
+        e = sinc_expansion(d, 4, CUTOFF).sum()
+        e += polynomial_cutoff(d, CUTOFF).sum()
+        e += cosine_cutoff(d, CUTOFF).sum()
+        return e
+
+    g = jax.grad(energy)(jnp.array([0.5, 2.0, 4.9]))
+    assert np.all(np.isfinite(np.asarray(g)))
