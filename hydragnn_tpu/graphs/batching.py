@@ -445,8 +445,15 @@ class PrefetchLoader:
         width = max(1, flags.get(flags.AFFINITY_WIDTH))
         offset = flags.get(flags.AFFINITY_OFFSET)
         idx = next(self._pin_counter)  # itertools.count: atomic under the GIL
-        ncpu = os.cpu_count() or 1
-        cores = {(offset + idx * width + k) % ncpu for k in range(width)}
+        # pick from the cpuset this process is actually allowed (containers
+        # often restrict it; absolute core ids would be silently rejected)
+        try:
+            allowed = sorted(os.sched_getaffinity(0))
+        except OSError:
+            return
+        cores = {
+            allowed[(offset + idx * width + k) % len(allowed)] for k in range(width)
+        }
         try:
             os.sched_setaffinity(0, cores)
         except OSError:

@@ -77,7 +77,12 @@ def degree_scaled_aggregate(
             raise ValueError(f"unknown aggregator {a}")
     agg = jnp.concatenate(outs, axis=-1)  # [N, A*F]
 
-    log_deg = jnp.log(deg + 1.0)
+    # PyG DegreeScalerAggregation clamps deg to >=1 before the scalers —
+    # without it a degree-0 node (padded dummy rows, isolated atoms) gets
+    # attenuation scale delta/log(1) -> ~1e6, which compounds per layer into
+    # inf/NaN on deep stacks
+    deg_c = jnp.maximum(deg, 1.0)
+    log_deg = jnp.log(deg_c + 1.0)
     scaled = []
     for s in scalers:
         if s == "identity":
@@ -85,11 +90,11 @@ def degree_scaled_aggregate(
         elif s == "amplification":
             scaled.append(agg * (log_deg / delta)[:, None])
         elif s == "attenuation":
-            scaled.append(agg * (delta / jnp.maximum(log_deg, 1e-6))[:, None])
+            scaled.append(agg * (delta / log_deg)[:, None])
         elif s == "linear":
-            scaled.append(agg * (deg / max(avg_deg_lin or 1.0, 1e-6))[:, None])
+            scaled.append(agg * (deg_c / max(avg_deg_lin or 1.0, 1e-6))[:, None])
         elif s == "inverse_linear":
-            scaled.append(agg * ((avg_deg_lin or 1.0) / jnp.maximum(deg, 1.0))[:, None])
+            scaled.append(agg * ((avg_deg_lin or 1.0) / deg_c)[:, None])
         else:
             raise ValueError(f"unknown scaler {s}")
     return jnp.concatenate(scaled, axis=-1)  # [N, A*S*F]

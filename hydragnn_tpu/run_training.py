@@ -104,7 +104,19 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
 
     # auto-scale to every local device: one SPMD program over a 1D data mesh
     # (HYDRAGNN_AUTO_PARALLEL=0 forces single-device; HYDRAGNN_USE_FSDP=1
-    # shards params/optimizer state — the reference's FSDP/ZeRO env knobs)
+    # shards params/optimizer state — the reference's FSDP/ZeRO env knobs).
+    # FSDP_STRATEGY maps the reference's torch strategies
+    # (distributed.py:435-437): NO_SHARD -> replicated, everything else ->
+    # param+opt sharding; validated HERE so a typo fails loudly even when no
+    # mesh ends up being built
+    _fsdp_requested = flags.get(flags.USE_FSDP)
+    _fsdp_strategy = str(flags.get(flags.FSDP_STRATEGY)).upper()
+    if _fsdp_requested:
+        _known = {"FULL_SHARD", "SHARD_GRAD_OP", "HYBRID_SHARD", "NO_SHARD"}
+        if _fsdp_strategy not in _known:
+            raise ValueError(
+                f"HYDRAGNN_FSDP_STRATEGY={_fsdp_strategy!r} not one of {sorted(_known)}"
+            )
     mesh = None
     try:
         import jax
@@ -127,16 +139,9 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             # FSDP_STRATEGY maps the reference's torch strategies
             # (distributed.py:435-437): NO_SHARD -> replicated, everything
             # else -> param+opt sharding over the data axis
-            use_fsdp = flags.get(flags.USE_FSDP)
-            strategy = str(flags.get(flags.FSDP_STRATEGY)).upper()
-            if use_fsdp:
-                known = {"FULL_SHARD", "SHARD_GRAD_OP", "HYBRID_SHARD", "NO_SHARD"}
-                if strategy not in known:
-                    raise ValueError(
-                        f"HYDRAGNN_FSDP_STRATEGY={strategy!r} not one of {sorted(known)}"
-                    )
             param_mode = (
-                "fsdp" if use_fsdp and strategy != "NO_SHARD" else "replicated"
+                "fsdp" if _fsdp_requested and _fsdp_strategy != "NO_SHARD"
+                else "replicated"
             )
             state = shard_state(state, mesh, param_mode=param_mode)
             # publish the mesh for trace-time consumers (ring attention)

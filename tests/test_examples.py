@@ -51,6 +51,13 @@ def test_example_md17():
     assert "energy RMSE" in out
 
 
+def test_example_ising():
+    out = run_example(
+        ["examples/ising_model/ising.py", "--epochs", "3", "--configs", "40"]
+    )
+    assert "energy RMSE" in out
+
+
 def test_example_multibranch():
     out = run_example(
         ["examples/multibranch/train.py", "--epochs", "2", "--configs", "16"]

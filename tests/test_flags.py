@@ -104,10 +104,11 @@ def test_affinity_pinning_smoke(monkeypatch):
     # distinct workers of one pool land on distinct cores
     if (os.cpu_count() or 1) >= 2:
         assert seen[0] != seen[1]
-    # a fresh pool starts over at the first core (no drift across epochs) —
-    # probe in a throwaway thread so the test process itself stays unpinned
+    # a fresh pool starts over at the first allowed core (no drift across
+    # epochs) — probe in a throwaway thread so the test process stays unpinned
     pl._reset_pins()
     t = threading.Thread(target=probe, args=("fresh",))
     t.start()
     t.join()
-    assert seen["fresh"] == {0}
+    first_allowed = sorted(os.sched_getaffinity(0))[0]
+    assert seen["fresh"] == {first_allowed}
