@@ -1,0 +1,120 @@
+"""Hyperparameter optimization on QM9-style data (reference
+``examples/qm9_hpo/qm9.py`` / ``qm9_optuna.py`` — grid/Optuna search over
+mpnn_type, hidden_dim, layer counts, scored by validation loss).
+
+Backends: ``--backend random`` (built-in) or ``--backend optuna`` (used when
+installed, silently falls back otherwise) — the reference's Optuna example;
+its DeepHyper variant maps to the same ``run_hpo`` space dict.
+
+    python examples/qm9_hpo/qm9_hpo.py [--trials 6] [--samples 120] [--epochs 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+BASE_CONFIG = {
+    "Verbosity": {"level": 0},
+    "Dataset": {
+        "name": "qm9_hpo",
+        "format": "unit_test",
+        "node_features": {"name": ["type"], "dim": [1], "column_index": [0]},
+        "graph_features": {"name": ["energy"], "dim": [1], "column_index": [0]},
+    },
+    "NeuralNetwork": {
+        "Architecture": {
+            "mpnn_type": "GIN",
+            "radius": 3.0,
+            "max_neighbours": 20,
+            "hidden_dim": 32,
+            "num_conv_layers": 2,
+            "output_heads": {
+                "graph": {
+                    "num_sharedlayers": 1,
+                    "dim_sharedlayers": 16,
+                    "num_headlayers": 2,
+                    "dim_headlayers": [32, 32],
+                }
+            },
+            "task_weights": [1.0],
+        },
+        "Variables_of_interest": {
+            "input_node_features": [0],
+            "output_index": [0],
+            "type": ["graph"],
+            "denormalize_output": False,
+        },
+        "Training": {
+            "num_epoch": 4,
+            "batch_size": 32,
+            "perc_train": 0.7,
+            "loss_function_type": "mse",
+            "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+        },
+    },
+}
+
+# the reference sweeps mpnn_type x width x depth (qm9_hpo/qm9.py argparse +
+# qm9_optuna.py suggest_* calls); dotted config paths -> categorical lists
+# or ("int"/"float"/"log_float", lo, hi) ranges
+SPACE = {
+    "NeuralNetwork.Architecture.mpnn_type": ["GIN", "SAGE", "PNA"],
+    "NeuralNetwork.Architecture.hidden_dim": [16, 32, 64],
+    "NeuralNetwork.Architecture.num_conv_layers": ("int", 1, 3),
+    "NeuralNetwork.Training.Optimizer.learning_rate": ("log_float", 1e-4, 1e-2),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=6)
+    ap.add_argument("--samples", type=int, default=120)
+    ap.add_argument("--epochs", type=int, default=None)
+    ap.add_argument("--backend", default="random", choices=["random", "optuna"])
+    ap.add_argument("--log", default="logs/qm9_hpo/result.json")
+    args = ap.parse_args()
+    if args.trials < 1:
+        ap.error("--trials must be >= 1")
+
+    if args.epochs is not None:
+        BASE_CONFIG["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "qm9"))
+    from qm9 import synthetic_molecules
+
+    import hydragnn_tpu
+    from hydragnn_tpu.utils.hpo import run_hpo
+
+    samples = synthetic_molecules(args.samples)
+
+    def objective(cfg) -> float:
+        import copy
+
+        trial_samples = copy.deepcopy(samples)
+        state, model, full_cfg = hydragnn_tpu.run_training(cfg, trial_samples)
+        from hydragnn_tpu.run_prediction import run_prediction
+
+        error, _, _, _ = run_prediction(full_cfg, state, model, samples=trial_samples)
+        return float(error)
+
+    best_cfg, best_val, history = run_hpo(
+        BASE_CONFIG, SPACE, objective,
+        n_trials=args.trials, backend=args.backend, log_path=args.log,
+    )
+    arch = best_cfg["NeuralNetwork"]["Architecture"]
+    print(
+        f"best: mpnn_type={arch['mpnn_type']} hidden={arch['hidden_dim']} "
+        f"layers={arch['num_conv_layers']} "
+        f"lr={best_cfg['NeuralNetwork']['Training']['Optimizer']['learning_rate']:.2e} "
+        f"-> objective {best_val:.5f} over {len(history)} trials"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,15 @@ def test_example_ising():
     assert "energy RMSE" in out
 
 
+def test_example_qm9_hpo():
+    out = run_example(
+        ["examples/qm9_hpo/qm9_hpo.py", "--trials", "2", "--samples", "40",
+         "--epochs", "1"],
+        timeout=600,
+    )
+    assert "best: mpnn_type=" in out
+
+
 def test_example_multibranch():
     out = run_example(
         ["examples/multibranch/train.py", "--epochs", "2", "--configs", "16"]
