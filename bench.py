@@ -306,6 +306,36 @@ def bench_gps(batch_size: int, bench_steps: int, warmup: int) -> dict:
     )
 
 
+def bench_oc20(batch_size: int, bench_steps: int, warmup: int) -> dict:
+    """OC20-style S2EF: EGNN energy+force training on periodic 64-atom LJ
+    cells (dense ~40-neighbor radius graphs) — the north-star catalyst
+    workload from BASELINE.json, heavier per graph than the QM9-like rows."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.datasets import lennard_jones_data
+    from hydragnn_tpu.models.mlip import make_mlip_train_step
+
+    cfg = copy.deepcopy(MLIP_CONFIG)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch["radius"] = 5.0
+    arch["max_neighbours"] = 40
+    cfg["Dataset"]["name"] = "bench_oc20"
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    samples = lennard_jones_data(
+        number_configurations=max(batch_size * 4, 128),
+        cells_per_dim=4,
+        radius=5.0,
+        max_neighbours=40,
+        relative_maximum_atomic_displacement=0.05,
+        seed=11,
+    )
+    return _run_workload(
+        "oc20_s2ef_egnn", cfg, samples,
+        lambda m, o: make_mlip_train_step(m, o, compute_dtype=jnp.float32),
+        "fp32", batch_size, bench_steps, warmup,
+    )
+
+
 def bench_mlip(batch_size: int, bench_steps: int, warmup: int) -> dict:
     """EGNN energy+force training (jax.grad forces) on LJ-like molecules.
     fp32 compute: bf16 under grad-of-grad loses force accuracy, so this is
@@ -399,6 +429,8 @@ def child_main(status_path: str) -> None:
         ("gin", lambda: bench_gin(batch_size, bench_steps, warmup)),
         ("mlip", lambda: bench_mlip(min(batch_size, 64), bench_steps, warmup)),
         ("gps", lambda: bench_gps(min(batch_size, 128), bench_steps, warmup)),
+        # after gps: keeps row continuity with earlier rounds if budget runs out
+        ("oc20", lambda: bench_oc20(min(batch_size, 32), bench_steps, warmup)),
     ]
     if os.getenv("BENCH_FUSED_AB", "1") != "0":
         def fused_ab():

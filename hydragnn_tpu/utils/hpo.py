@@ -97,8 +97,14 @@ def run_hpo(
             assignment = sample_config(space, rng)
             value = float(objective(build(assignment)))
             history.append({"assignment": assignment, "value": value})
-            if value < best_value:
+            # NaN objectives (diverged trials) never beat any finite value
+            if np.isfinite(value) and value < best_value:
                 best_assignment, best_value = assignment, value
+        if best_assignment is None:
+            raise RuntimeError(
+                f"all {n_trials} HPO trials returned non-finite objectives "
+                f"(history: {[h['value'] for h in history]})"
+            )
 
     if log_path:
         os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
