@@ -150,7 +150,9 @@ def main():
     model = create_model_config(config)
     opt = select_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
 
-    loaders, pad = make_branch_loaders(datasets, batch_size=args.batch)
+    loaders, pad = make_branch_loaders(
+        datasets, batch_size=args.batch, min_samples=args.batch * n_data
+    )
     mesh = make_mesh(n_branch=n_branch, n_data=n_data)
 
     first = next(iter(loaders[0]))

@@ -1,0 +1,165 @@
+"""GFM multidataset HPO trainer (reference ``examples/multidataset_hpo/gfm.py``):
+one HPO trial = short multibranch pretraining over N packed stores with
+hyperparameters taken from argv, reporting the final validation loss on a
+machine-parseable line (``HPO_OBJECTIVE: <val_loss>``) that the search driver
+(`gfm_hpo.py`) consumes — the role of the reference's DeepHyper job scripts.
+
+    python examples/multidataset_hpo/gfm.py --multi a.gpk,b.gpk \
+        --mpnn_type EGNN --hidden_dim 50 --num_conv_layers 3 \
+        --num_headlayers 2 --dim_headlayers 80 --lr 1e-3
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--multi", type=str, required=True,
+                    help="comma-separated packed dataset paths, one per branch")
+    # the reference's HPO dimensions (gfm_deephyper_multi.py problem space)
+    ap.add_argument("--mpnn_type", type=str, default="GIN",
+                    choices=["GIN", "SAGE", "EGNN", "SchNet", "PNA"])
+    ap.add_argument("--num_conv_layers", type=int, default=3)
+    ap.add_argument("--hidden_dim", type=int, default=32)
+    ap.add_argument("--num_headlayers", type=int, default=2)
+    ap.add_argument("--dim_headlayers", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.005)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--val-frac", type=float, default=0.2)
+    args = ap.parse_args()
+
+    import jax
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.datasets.packed import GlobalShuffleStore
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.parallel import (
+        make_mesh,
+        make_parallel_eval_step,
+        make_parallel_train_step,
+        put_batch,
+        shard_state,
+        stack_device_batches,
+    )
+    from hydragnn_tpu.preprocess import apply_variables_of_interest
+    from hydragnn_tpu.train import create_train_state, select_optimizer
+    from hydragnn_tpu.train.multibranch import (
+        branch_device_batches,
+        make_branch_loaders,
+    )
+
+    paths = [p for p in args.multi.split(",") if p]
+    n_branch = len(paths)
+    n_dev = len(jax.devices())
+    n_data = max(1, n_dev // n_branch)
+
+    branch_arch = {
+        "num_sharedlayers": 1,
+        "dim_sharedlayers": 16,
+        "num_headlayers": args.num_headlayers,
+        "dim_headlayers": [args.dim_headlayers] * args.num_headlayers,
+    }
+    config = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "gfm_hpo",
+            "format": "packed",
+            "node_features": {"name": ["type", "x", "x2", "x3"], "dim": [1, 1, 1, 1],
+                               "column_index": [0, 1, 2, 3]},
+            "graph_features": {"name": ["sum"], "dim": [1], "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": args.mpnn_type,
+                "radius": 2.0,
+                "max_neighbours": 20,
+                "hidden_dim": args.hidden_dim,
+                "num_conv_layers": args.num_conv_layers,
+                "output_heads": {
+                    "graph": [
+                        {"type": f"branch-{i}", "architecture": dict(branch_arch)}
+                        for i in range(n_branch)
+                    ]
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "num_epoch": args.epochs,
+                "batch_size": args.batch,
+                "loss_function_type": "mse",
+                "Optimizer": {"type": "AdamW", "learning_rate": args.lr},
+            },
+        },
+    }
+
+    rng = np.random.default_rng(0)
+    train_sets, val_sets = {}, []
+    for b, path in enumerate(paths):
+        store = GlobalShuffleStore(path)
+        samples = store.ds.load_all()
+        samples = apply_variables_of_interest(samples, config)
+        for s in samples:
+            s.dataset_id = b
+        perm = rng.permutation(len(samples))
+        n_val = max(1, int(len(samples) * args.val_frac))
+        val_sets.append([samples[i] for i in perm[:n_val]])
+        train_sets[f"branch-{b}"] = [samples[i] for i in perm[n_val:]]
+
+    allsamples = [s for ds in train_sets.values() for s in ds]
+    config = update_config(config, allsamples)
+    model = create_model_config(config)
+    opt = select_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+
+    # floor at one full mesh step (n_data batches/branch) so tiny CI-sized
+    # branches still train instead of yielding zero steps per epoch
+    loaders, pad = make_branch_loaders(
+        train_sets, batch_size=args.batch, min_samples=args.batch * n_data
+    )
+    mesh = make_mesh(n_branch=n_branch, n_data=n_data)
+
+    first = next(iter(loaders[0]))
+    state = create_train_state(model, opt, first)
+    state = shard_state(state, mesh, param_mode="branch")
+    train_step = make_parallel_train_step(model, opt, mesh)
+    eval_step = make_parallel_eval_step(model, mesh)
+
+    for epoch in range(args.epochs):
+        for step_batches in branch_device_batches(loaders, epoch, n_data):
+            sb = put_batch(stack_device_batches(step_batches), mesh)
+            state, metrics = train_step(state, sb)
+
+    # validation: same mesh row layout; oversample every branch to at least
+    # one full mesh step (n_data batches) so tiny val splits still evaluate
+    from hydragnn_tpu.train.multibranch import OversamplingLoader
+
+    val_target = max(max(len(v) for v in val_sets), args.batch * n_data)
+    val_loaders = [
+        OversamplingLoader(v, args.batch, num_samples=val_target, pad=pad,
+                           seed=97 + 31 * b)
+        for b, v in enumerate(val_sets)
+    ]
+    val_losses = []
+    for step_batches in branch_device_batches(val_loaders, 0, n_data):
+        sb = put_batch(stack_device_batches(step_batches), mesh)
+        metrics = eval_step(state, sb)
+        val_losses.append(float(metrics["loss"]))
+    val = float(np.mean(val_losses)) if val_losses else float("nan")
+    print(f"HPO_OBJECTIVE: {val:.8f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

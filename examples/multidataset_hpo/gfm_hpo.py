@@ -1,0 +1,134 @@
+"""GFM multidataset HPO search driver (reference
+``examples/multidataset_hpo/gfm_deephyper_multi.py``: DeepHyper CBO +
+ProcessPoolEvaluator spawning one srun training job per trial).
+
+TPU-native reshape: each trial is an isolated subprocess running ``gfm.py``
+(own jax runtime, like the reference's per-trial srun job); the search loop
+is ``hydragnn_tpu.utils.hpo.run_hpo`` with ``workers`` concurrent trial
+jobs. The search space matches the reference problem definition (mpnn_type,
+num_conv_layers, hidden_dim, num_headlayers, dim_headlayers + learning
+rate); objective = final validation loss, minimized.
+
+    python examples/multidataset_hpo/gfm_hpo.py --make-synthetic /tmp/gfm \
+        --trials 8 --workers 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the reference's CBO problem dimensions (gfm_deephyper_multi.py:36-47),
+# ranges scaled down to CI-runnable sizes
+SPACE = {
+    "mpnn_type": ["GIN", "SAGE", "EGNN", "SchNet"],
+    "num_conv_layers": ("int", 2, 4),
+    "hidden_dim": ("int", 16, 64),
+    "num_headlayers": ("int", 1, 3),
+    "dim_headlayers": ("int", 16, 64),
+    "lr": ("log_float", 1e-4, 1e-2),
+}
+
+_fail_lock = threading.Lock()
+_last_failure: dict = {}
+
+
+def make_trial_objective(paths: list[str], epochs: int, batch: int,
+                         timeout: float):
+    """One trial = one subprocess training job; returns the val loss (inf on
+    failure, so broken configs lose instead of crashing the search). The last
+    failure's stderr tail is kept for the all-trials-failed diagnostic."""
+
+    def objective(assignment: dict) -> float:
+        cmd = [
+            sys.executable, os.path.join(REPO, "examples/multidataset_hpo/gfm.py"),
+            "--multi", ",".join(paths), "--epochs", str(epochs),
+            "--batch", str(batch),
+        ]
+        for key, val in assignment.items():
+            cmd += [f"--{key}", str(val)]
+        try:
+            proc = subprocess.run(
+                cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout,
+                env=dict(os.environ,
+                         PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", "")),
+            )
+        except subprocess.TimeoutExpired:
+            with _fail_lock:
+                _last_failure.clear()
+                _last_failure.update(assignment=assignment,
+                                     reason=f"timeout after {timeout}s")
+            return float("inf")
+        for line in proc.stdout.splitlines():
+            if line.startswith("HPO_OBJECTIVE:"):
+                val = float(line.split(":", 1)[1])
+                return val if np.isfinite(val) else float("inf")
+        with _fail_lock:
+            _last_failure.clear()
+            _last_failure.update(
+                assignment=assignment, returncode=proc.returncode,
+                stderr_tail=proc.stderr[-2000:],
+            )
+        return float("inf")
+
+    return objective
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--multi", type=str, default=None,
+                    help="comma-separated packed dataset paths, one per branch")
+    ap.add_argument("--make-synthetic", type=str, default=None, metavar="DIR")
+    ap.add_argument("--branches", type=int, default=2)
+    ap.add_argument("--configs", type=int, default=24)
+    ap.add_argument("--trials", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=1,
+                    help="concurrent trial jobs (the ProcessPoolEvaluator width)")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--trial-timeout", type=float, default=600.0)
+    ap.add_argument("--log", type=str, default=None, help="JSON history output")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    from hydragnn_tpu.utils.hpo import run_hpo
+
+    if args.multi is None:
+        outdir = args.make_synthetic or "./gfm_hpo_synthetic"
+        from examples.multidataset.train import make_synthetic
+
+        paths = make_synthetic(outdir, args.branches, args.configs)
+        print(f"synthesized {len(paths)} packed stores under {outdir}")
+    else:
+        paths = [p for p in args.multi.split(",") if p]
+
+    objective = make_trial_objective(paths, args.epochs, args.batch,
+                                     args.trial_timeout)
+    try:
+        best_cfg, best_value, history = run_hpo(
+            {}, SPACE, objective, n_trials=args.trials, seed=args.seed,
+            workers=args.workers, log_path=args.log,
+        )
+    except RuntimeError:
+        if _last_failure:
+            print(f"last trial failure: {_last_failure}", file=sys.stderr)
+        raise
+    for h in history:
+        print(f"trial {h['assignment']} -> {h['value']:.6f}")
+    print(
+        "best: " + " ".join(f"{k}={v}" for k, v in best_cfg.items())
+        + f" val_loss={best_value:.6f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,7 @@ _FIELDS = (
     ("node_y", np.float32),
     ("energy_y", np.float32),
     ("forces_y", np.float32),
+    ("graph_attr", np.float32),
     ("node_table", np.float32),
     ("graph_table", np.float32),
 )
@@ -76,6 +77,15 @@ class PackedWriter:
                 )
             cols = widths.pop() if widths else 1
             counts = np.array([v.shape[0] for v in vals], np.int64)
+            # graph_attr rides the ragged dim (cols is always 1), so the
+            # width check above can't catch per-sample length mismatches —
+            # which would collate into broadcast errors far from here
+            if name == "graph_attr" and len(np.unique(counts)) > 1:
+                raise ValueError(
+                    "graph_attr length differs across samples "
+                    f"({sorted(set(counts.tolist()))}); conditioning attributes "
+                    "must be homogeneous (or absent everywhere)"
+                )
             data = (
                 np.concatenate(vals, axis=0)
                 if vals
@@ -184,6 +194,12 @@ class PackedDataset:
             node_y=get("node_y", i),
             energy_y=get("energy_y", i)[:, 0],
             forces_y=get("forces_y", i),
+            # absent from pre-graph_attr files: stays None -> zero-width
+            graph_attr=(
+                get("graph_attr", i)[:, 0]
+                if "graph_attr" in self._keys and self._counts["graph_attr"][i]
+                else None
+            ),
             dataset_id=int(get("dataset_id", i)[0, 0]),
         )
         nt = get("node_table", i)

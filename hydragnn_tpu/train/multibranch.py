@@ -75,17 +75,22 @@ def make_branch_loaders(
     batch_size: int,
     n_branch_rows: int | None = None,
     seed: int = 0,
+    min_samples: int = 0,
 ) -> tuple[list[GraphLoader], PadSpec]:
     """One oversampling loader per branch, all sharing a pad bucket, each
     sized to the LARGEST branch so every branch takes the same number of
-    steps per epoch (the SC25 weak-scaling recipe's oversampling)."""
+    steps per epoch (the SC25 weak-scaling recipe's oversampling).
+
+    ``min_samples`` floors the per-branch epoch length — pass
+    ``batch_size * n_data`` when feeding a (branch, data) mesh so tiny
+    branches still yield at least one full mesh step per epoch."""
     if isinstance(datasets, dict):
         branches = list(datasets.values())
     else:
         branches = list(datasets)
     samples_all = concat_multidataset(datasets)
     pad = compute_pad_spec(samples_all, batch_size)
-    target = max(len(b) for b in branches)
+    target = max(max(len(b) for b in branches), min_samples)
     loaders = [
         OversamplingLoader(
             b, batch_size, num_samples=target, pad=pad, seed=seed + 31 * i

@@ -53,10 +53,16 @@ def run_hpo(
     seed: int = 0,
     backend: str = "random",
     log_path: str | None = None,
+    workers: int = 1,
 ) -> tuple[dict, float, list]:
     """Minimize ``objective(config)`` over ``space``. Space keys are dotted
     config paths (e.g. ``"NeuralNetwork.Architecture.hidden_dim"``).
-    Returns (best_config, best_value, trial history)."""
+    Returns (best_config, best_value, trial history).
+
+    ``workers > 1`` evaluates random-search trials concurrently through a
+    thread pool (the reference's DeepHyper ProcessPoolEvaluator width,
+    ``examples/multidataset_hpo/gfm_deephyper_multi.py``) — the objective
+    must be thread-safe, e.g. a subprocess launcher."""
     history = []
 
     def build(assignment: dict) -> dict:
@@ -92,12 +98,18 @@ def run_hpo(
         best_value = study.best_value
     else:
         rng = np.random.default_rng(seed)
+        assignments = [sample_config(space, rng) for _ in range(n_trials)]
+        if workers > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                values = list(pool.map(lambda a: float(objective(build(a))), assignments))
+        else:
+            values = [float(objective(build(a))) for a in assignments]
         best_assignment, best_value = None, float("inf")
-        for _ in range(n_trials):
-            assignment = sample_config(space, rng)
-            value = float(objective(build(assignment)))
+        for assignment, value in zip(assignments, values):
             history.append({"assignment": assignment, "value": value})
-            # NaN objectives (diverged trials) never beat any finite value
+            # NaN/inf objectives (diverged trials) never beat any finite value
             if np.isfinite(value) and value < best_value:
                 best_assignment, best_value = assignment, value
         if best_assignment is None:

@@ -94,6 +94,31 @@ def test_example_multidataset_packed(tmp_path):
     assert "epoch 0" in out2
 
 
+def test_example_multidataset_hpo(tmp_path):
+    """GFM HPO driver: concurrent subprocess trials over packed stores."""
+    d = str(tmp_path / "gfmhpo")
+    out = run_example(
+        ["examples/multidataset_hpo/gfm_hpo.py", "--make-synthetic", d,
+         "--trials", "2", "--workers", "2", "--epochs", "1", "--configs", "16"],
+        timeout=600,
+    )
+    assert "best: mpnn_type=" in out
+    assert "val_loss=" in out
+
+
+def test_example_mptrj(tmp_path):
+    """MPTrj-style driver: E/atom training with force-outlier filtering,
+    (charge, spin) FiLM conditioning and linreg baseline subtraction."""
+    d = str(tmp_path / "mptrj")
+    out = run_example(
+        ["examples/mptrj/train.py", "--make-synthetic", d, "--configs", "20",
+         "--epochs", "2", "--batch", "4", "--linreg"]
+    )
+    assert "synthesized MPTrj store" in out
+    assert "linear-regression baseline" in out
+    assert "eV/atom" in out
+
+
 def test_example_oc20_s2ef(tmp_path):
     """OC20-style S2EF driver: packed store -> MLIP energy+force training."""
     d = str(tmp_path / "oc20")
