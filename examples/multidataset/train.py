@@ -87,7 +87,13 @@ def main():
 
     n_branch = len(paths)
     n_dev = len(jax.devices())
-    n_data = max(1, n_dev // n_branch)
+    if n_dev < n_branch:
+        raise SystemExit(
+            f"{n_branch} branches need >= {n_branch} devices, found {n_dev} "
+            "(on CPU set XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+    n_data = n_dev // n_branch
+    mesh_devices = jax.devices()[: n_branch * n_data]  # drop the remainder
     print(f"mesh: ({n_branch} branch x {n_data} data) over {n_dev} devices")
 
     branch_arch = {
@@ -153,7 +159,7 @@ def main():
     loaders, pad = make_branch_loaders(
         datasets, batch_size=args.batch, min_samples=args.batch * n_data
     )
-    mesh = make_mesh(n_branch=n_branch, n_data=n_data)
+    mesh = make_mesh(n_branch=n_branch, n_data=n_data, devices=mesh_devices)
 
     first = next(iter(loaders[0]))
     state = create_train_state(model, opt, first)

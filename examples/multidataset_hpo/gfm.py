@@ -7,6 +7,10 @@ machine-parseable line (``HPO_OBJECTIVE: <val_loss>``) that the search driver
     python examples/multidataset_hpo/gfm.py --multi a.gpk,b.gpk \
         --mpnn_type EGNN --hidden_dim 50 --num_conv_layers 3 \
         --num_headlayers 2 --dim_headlayers 80 --lr 1e-3
+
+Needs >= one device per branch; on CPU run under
+``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``
+(`gfm_hpo.py` sets this for its trial subprocesses automatically).
 """
 
 from __future__ import annotations
@@ -60,7 +64,13 @@ def main():
     paths = [p for p in args.multi.split(",") if p]
     n_branch = len(paths)
     n_dev = len(jax.devices())
-    n_data = max(1, n_dev // n_branch)
+    if n_dev < n_branch:
+        raise SystemExit(
+            f"{n_branch} branches need >= {n_branch} devices, found {n_dev} "
+            "(on CPU set XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+    n_data = n_dev // n_branch
+    mesh_devices = jax.devices()[: n_branch * n_data]  # drop the remainder
 
     branch_arch = {
         "num_sharedlayers": 1,
@@ -129,7 +139,7 @@ def main():
     loaders, pad = make_branch_loaders(
         train_sets, batch_size=args.batch, min_samples=args.batch * n_data
     )
-    mesh = make_mesh(n_branch=n_branch, n_data=n_data)
+    mesh = make_mesh(n_branch=n_branch, n_data=n_data, devices=mesh_devices)
 
     first = next(iter(loaders[0]))
     state = create_train_state(model, opt, first)

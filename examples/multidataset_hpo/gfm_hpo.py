@@ -56,11 +56,18 @@ def make_trial_objective(paths: list[str], epochs: int, batch: int,
         ]
         for key, val in assignment.items():
             cmd += [f"--{key}", str(val)]
+        env = dict(os.environ,
+                   PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+        # trial meshes need >= n_branch devices; on CPU hosts give each
+        # trial a virtual 8-device mesh unless the caller already chose one
+        if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+            ).strip()
         try:
             proc = subprocess.run(
                 cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout,
-                env=dict(os.environ,
-                         PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", "")),
+                env=env,
             )
         except subprocess.TimeoutExpired:
             with _fail_lock:

@@ -77,13 +77,14 @@ class PackedWriter:
                 )
             cols = widths.pop() if widths else 1
             counts = np.array([v.shape[0] for v in vals], np.int64)
-            # graph_attr rides the ragged dim (cols is always 1), so the
-            # width check above can't catch per-sample length mismatches —
-            # which would collate into broadcast errors far from here
-            if name == "graph_attr" and len(np.unique(counts)) > 1:
+            # per-graph vectors (graph_y targets, graph_attr conditioning)
+            # ride the ragged dim with cols=1, so the width check above can't
+            # catch per-sample length mismatches — which would collate into
+            # broadcast errors far from the write site
+            if name in ("graph_y", "graph_attr") and len(np.unique(counts)) > 1:
                 raise ValueError(
-                    "graph_attr length differs across samples "
-                    f"({sorted(set(counts.tolist()))}); conditioning attributes "
+                    f"{name} length differs across samples "
+                    f"({sorted(set(counts.tolist()))}); per-graph vectors "
                     "must be homogeneous (or absent everywhere)"
                 )
             data = (

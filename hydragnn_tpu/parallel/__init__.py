@@ -1,10 +1,12 @@
 from .mesh import (
     DATA_AXIS,
     BRANCH_AXIS,
+    MODEL_AXIS,
     make_mesh,
     batch_sharding,
     replicated,
     fsdp_param_specs,
+    tp_param_specs,
 )
 from .step import (
     make_parallel_train_step,
@@ -18,10 +20,12 @@ from .step import (
 __all__ = [
     "DATA_AXIS",
     "BRANCH_AXIS",
+    "MODEL_AXIS",
     "make_mesh",
     "batch_sharding",
     "replicated",
     "fsdp_param_specs",
+    "tp_param_specs",
     "make_parallel_train_step",
     "make_parallel_eval_step",
     "shard_state",

@@ -28,21 +28,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 BRANCH_AXIS = "branch"
+MODEL_AXIS = "model"
 
 
 def make_mesh(
     n_data: int | None = None,
     n_branch: int = 1,
+    n_model: int = 1,
     devices: Sequence | None = None,
 ) -> Mesh:
-    """Build a (branch, data) mesh. Defaults to all devices on one data axis."""
+    """Build a (branch, data[, model]) mesh. Defaults to all devices on one
+    data axis. ``n_model > 1`` adds a trailing tensor-parallel axis — keep it
+    innermost so TP collectives ride the fastest ICI ring."""
     devices = list(devices if devices is not None else jax.devices())
     if n_data is None:
-        n_data = len(devices) // n_branch
-    if n_branch * n_data != len(devices):
+        n_data = len(devices) // (n_branch * n_model)
+    if n_branch * n_data * n_model != len(devices):
         raise ValueError(
-            f"mesh ({n_branch} branch x {n_data} data) != {len(devices)} devices"
+            f"mesh ({n_branch} branch x {n_data} data x {n_model} model) "
+            f"!= {len(devices)} devices"
         )
+    if n_model > 1:
+        arr = np.asarray(devices).reshape(n_branch, n_data, n_model)
+        return Mesh(arr, (BRANCH_AXIS, DATA_AXIS, MODEL_AXIS))
     arr = np.asarray(devices).reshape(n_branch, n_data)
     return Mesh(arr, (BRANCH_AXIS, DATA_AXIS))
 
@@ -86,6 +94,30 @@ def branch_param_specs(params, mesh: Mesh, min_size_to_shard: int = 0):
         else:
             out[key] = jax.tree.map(lambda _: P(), sub)
     return out
+
+
+def tp_param_specs(params, mesh: Mesh, min_size_to_shard: int = 2**10):
+    """Tensor parallelism: shard every weight's feature (last) axis over the
+    ``model`` axis — column-parallel dense layers in Megatron terms. The
+    GSPMD partitioner propagates the activation shardings and inserts the
+    all-gather/all-reduce pairs that hand-written TP implements explicitly,
+    and they ride the innermost (fastest) ICI ring because ``model`` is the
+    trailing mesh axis. Per-device parameter + activation memory for the
+    hidden dimension drops to 1/n_model — the axis to grow when a model's
+    hidden width, not the batch, is what no longer fits."""
+    if MODEL_AXIS not in mesh.axis_names:
+        raise ValueError("param_mode='tp' needs a mesh with a 'model' axis "
+                         "(make_mesh(n_model=...))")
+    n_model = mesh.shape[MODEL_AXIS]
+
+    def spec_for(x):
+        if x.ndim == 0 or x.size < min_size_to_shard:
+            return P()
+        if x.shape[-1] % n_model == 0:
+            return P(*([None] * (x.ndim - 1)), MODEL_AXIS)
+        return P()
+
+    return jax.tree.map(spec_for, params)
 
 
 def fsdp_param_specs(params, mesh: Mesh, min_size_to_shard: int = 2**14):

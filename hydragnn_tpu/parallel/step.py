@@ -59,8 +59,9 @@ def _place(x, mesh: Mesh, spec: P):
 
 def shard_state(state: TrainState, mesh: Mesh, param_mode: str = "replicated") -> TrainState:
     """Place a TrainState on the mesh. ``param_mode``: 'replicated' (DDP),
-    'fsdp' (ZeRO-3 over data), or 'branch' (multibranch decoders sharded over
-    the branch axis, encoder replicated). Optimizer state follows the param
+    'fsdp' (ZeRO-3 over data), 'branch' (multibranch decoders sharded over
+    the branch axis, encoder replicated), or 'tp' (feature-axis tensor
+    parallelism over the model axis). Optimizer state follows the param
     sharding — ZeRO-1 for free."""
     if param_mode == "fsdp":
         pspecs = fsdp_param_specs(state.params, mesh)
@@ -68,8 +69,17 @@ def shard_state(state: TrainState, mesh: Mesh, param_mode: str = "replicated") -
         from .mesh import branch_param_specs
 
         pspecs = branch_param_specs(state.params, mesh)
-    else:
+    elif param_mode == "tp":
+        from .mesh import tp_param_specs
+
+        pspecs = tp_param_specs(state.params, mesh)
+    elif param_mode == "replicated":
         pspecs = jax.tree.map(lambda _: P(), state.params)
+    else:
+        raise ValueError(
+            f"unknown param_mode {param_mode!r}; expected one of "
+            "'replicated', 'fsdp', 'branch', 'tp'"
+        )
 
     def put(tree, specs):
         return jax.tree.map(lambda x, s: _place(x, mesh, s), tree, specs)

@@ -93,7 +93,7 @@ def run_hpo(
             return value
 
         study = optuna.create_study(direction="minimize")
-        study.optimize(opt_objective, n_trials=n_trials)
+        study.optimize(opt_objective, n_trials=n_trials, n_jobs=max(workers, 1))
         best_assignment = study.best_params
         best_value = study.best_value
     else:
