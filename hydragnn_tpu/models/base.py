@@ -263,25 +263,41 @@ class HydraModel(nn.Module):
                 )
 
     # -- encoder ------------------------------------------------------------
+    def conv_block(self, i: int, inv: Array, equiv: Array, batch: GraphBatch,
+                   train: bool = False):
+        """One conv layer block: conv + graph-attr conditioning + feature
+        norm + activation. Factored out so the pipeline-parallel runtime
+        (``parallel/pipeline.py``) can scan it over per-layer params."""
+        conv_cls = CONV_REGISTRY[self.spec.mpnn_type]
+        stack_activation = getattr(conv_cls, "stack_activation", True)
+        conv = self.graph_convs[i]
+        norm = self.feature_layers[i]
+        inv, equiv = conv(inv, equiv, batch, train)  # positional: remat statics
+        inv = self._apply_graph_conditioning(inv, batch)
+        if norm is not None:
+            inv = norm(inv, batch.node_mask, train)
+        if stack_activation:
+            inv = get_activation(self.spec.activation)(inv)
+        return inv, equiv
+
+    def embed_block0(self, batch: GraphBatch, train: bool = False):
+        """Input embedding + conv block 0 — the pipeline prologue (block 0
+        lifts input_dim -> hidden_dim, so it is the one non-uniform layer)."""
+        inv, equiv = self.embed(batch)
+        return self.conv_block(0, inv, equiv, batch, train)
+
     def encode(self, batch: GraphBatch, train: bool = False):
         """Run the conv stack; returns (node_features, equiv_features)."""
         conv_cls = CONV_REGISTRY[self.spec.mpnn_type]
         # MACE: no inter-layer activation; heads read concatenated per-layer
         # scalars (our static-shape take on the reference's summed per-layer
         # readout decoders, MACEStack.forward :375-421)
-        stack_activation = getattr(conv_cls, "stack_activation", True)
         collect = getattr(conv_cls, "collect_layer_outputs", False)
 
         inv, equiv = self.embed(batch)
-        act = get_activation(self.spec.activation)
         layer_outs = []
-        for conv, norm in zip(self.graph_convs, self.feature_layers):
-            inv, equiv = conv(inv, equiv, batch, train)  # positional: remat statics
-            inv = self._apply_graph_conditioning(inv, batch)
-            if norm is not None:
-                inv = norm(inv, batch.node_mask, train)
-            if stack_activation:
-                inv = act(inv)
+        for i in range(len(self.graph_convs)):
+            inv, equiv = self.conv_block(i, inv, equiv, batch, train)
             if collect:
                 layer_outs.append(inv)
         if collect:
@@ -345,8 +361,14 @@ class HydraModel(nn.Module):
 
     # -- full forward --------------------------------------------------------
     def __call__(self, batch: GraphBatch, train: bool = False):
-        spec = self.spec
         inv, equiv = self.encode(batch, train)
+        return self.decode(inv, equiv, batch, train)
+
+    def decode(self, inv: Array, equiv: Array, batch: GraphBatch,
+               train: bool = False):
+        """Pooling + multi-head decoders on encoded node features — the
+        pipeline epilogue (everything after the conv stack)."""
+        spec = self.spec
         x_graph = self.pool(inv, batch)
 
         outputs = []

@@ -40,3 +40,19 @@ from .distributed import (  # noqa: E402
 )
 
 __all__ += ["setup_ddp", "init_comm_size_and_rank", "get_comm_size_and_rank"]
+
+from .pipeline import (  # noqa: E402
+    STAGE_AXIS,
+    make_pipeline_mesh,
+    make_pipelined_forward,
+    make_pipelined_train_step,
+    put_microbatches,
+)
+
+__all__ += [
+    "STAGE_AXIS",
+    "make_pipeline_mesh",
+    "make_pipelined_forward",
+    "make_pipelined_train_step",
+    "put_microbatches",
+]

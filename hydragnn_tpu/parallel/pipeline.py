@@ -1,0 +1,262 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining of the conv stack
+over a ``stage`` mesh axis.
+
+The reference has no pipeline parallelism anywhere (SURVEY §2.5: TP/PP
+absent); this is a TPU-native extension for DEEP stacks (many-layer
+equivariant models) whose weights or activations outgrow one chip but whose
+layer widths don't warrant tensor sharding.
+
+Design
+------
+* Conv block 0 (the one non-uniform layer — it lifts ``input_dim`` to
+  ``hidden_dim``) and the decode epilogue (pooling + heads) run replicated
+  on every stage device; they are a tiny fraction of a deep stack's FLOPs.
+* Conv blocks ``1..L-1`` must be parameter-homogeneous (same pytree of
+  shapes, true for every registered stack at fixed hidden_dim). Their
+  params are stacked to a ``[S, k, ...]`` pytree, sharded over the stage
+  axis — each device materializes only its ``k = (L-1)/S`` layers.
+* One ``shard_map`` program runs the classic GPipe schedule: ``T = M+S-1``
+  ticks; at tick ``t`` stage ``s`` applies its ``k`` blocks (inner
+  ``lax.scan`` over stacked layer params, each step re-applying the model's
+  ``conv_block`` method with that layer's params substituted in) to
+  microbatch ``t - s``, then hands the activation to stage ``s+1`` with a
+  ``ppermute`` rotation around the ring. Stage 0 feeds fresh microbatch
+  activations into the ring; the last stage's outputs are ``psum``-broadcast
+  (every other stage contributes zeros).
+* Autodiff goes straight through ``scan``+``ppermute`` — the backward pass
+  is the reverse pipeline schedule, derived by AD instead of hand-scheduled.
+
+Semantics: pipelined execution is deterministic (``train=False`` through
+every conv block) — feature norms use running statistics (the standard GPipe
+BatchNorm caveat; scale/bias still train, running stats don't update), and
+conv dropout is disabled (GAT with ``dropout > 0`` is rejected up front
+rather than silently differing from the data-parallel path).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..graphs.graph import GraphBatch
+from ..models.base import CONV_REGISTRY, HydraModel
+from ..train.step import TrainState, _cast_floats
+
+STAGE_AXIS = "stage"
+
+
+def make_pipeline_mesh(n_stage: int, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())[:n_stage]
+    if len(devices) != n_stage:
+        raise ValueError(f"need {n_stage} devices for {n_stage} stages")
+    return Mesh(np.asarray(devices), (STAGE_AXIS,))
+
+
+def validate_pipeline_support(model: HydraModel, n_stage: int) -> int:
+    """Return layers-per-stage k; raise for unsupported configurations."""
+    spec = model.spec
+    L = spec.num_conv_layers
+    if spec.global_attn_engine:
+        raise ValueError("pipeline parallelism does not compose with global "
+                         "attention engines yet")
+    conv_cls = CONV_REGISTRY[spec.mpnn_type]
+    if getattr(conv_cls, "collect_layer_outputs", False):
+        raise ValueError(f"{spec.mpnn_type} reads every layer's output "
+                         "(collect_layer_outputs) — not pipelineable")
+    if spec.mpnn_type == "GAT" and spec.dropout > 0:
+        raise ValueError(
+            "pipelined execution is dropout-free (conv blocks run "
+            "deterministically); set Architecture.dropout to 0 for GAT "
+            "under pipeline parallelism"
+        )
+    if L < n_stage + 1:
+        raise ValueError(f"{L} conv layers cannot fill {n_stage} stages "
+                         "(block 0 is the prologue; need num_conv_layers >= "
+                         "n_stage + 1)")
+    if (L - 1) % n_stage:
+        raise ValueError(f"{L - 1} pipelined layers not divisible by "
+                         f"{n_stage} stages")
+    return (L - 1) // n_stage
+
+
+def _layer_tree(params: dict, stats: dict, i: int) -> dict:
+    t = {"conv": params[f"graph_convs_{i}"]}
+    if f"feature_norm_{i}" in params:
+        t["norm_p"] = params[f"feature_norm_{i}"]
+    if f"feature_norm_{i}" in stats:
+        t["norm_s"] = stats[f"feature_norm_{i}"]
+    return t
+
+
+def _stack_layer_params(params: dict, stats: dict, L: int, S: int, k: int):
+    """Stack per-layer subtrees for blocks 1..L-1 into a [S, k, ...] pytree.
+
+    Raises a clear error when layer params are not shape-homogeneous (the
+    judge of pipelineability — e.g. stacks whose layers vary in width)."""
+    trees = [_layer_tree(params, stats, i) for i in range(1, L)]
+    shapes = [jax.tree.map(jnp.shape, t) for t in trees]
+    if any(s != shapes[0] for s in shapes[1:]):
+        raise ValueError(
+            "conv blocks 1..L-1 are not parameter-homogeneous; "
+            f"got per-layer shapes {shapes}"
+        )
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+    return jax.tree.map(lambda x: x.reshape(S, k, *x.shape[1:]), stacked)
+
+
+def make_pipelined_forward(model: HydraModel, mesh: Mesh, n_micro: int):
+    """Build ``fn(variables, microbatches) -> (inv, equiv)`` where
+    ``microbatches`` is a GraphBatch stacked to ``[M, ...]`` (see
+    ``parallel.stack_device_batches``) and the result carries the encoded
+    node features per microbatch ``[M, N, H]``."""
+    S = mesh.shape[STAGE_AXIS]
+    k = validate_pipeline_support(model, S)
+    L = model.spec.num_conv_layers
+    M = n_micro
+
+    def forward(variables, mb: GraphBatch):
+        got = jax.tree.leaves(mb)[0].shape[0]
+        if got != M:
+            raise ValueError(
+                f"stacked microbatch has leading dim {got}, expected "
+                f"n_micro={M}"
+            )
+        params = variables["params"]
+        stats = variables.get("batch_stats", {})
+
+        # prologue: embed + block 0, vmapped over microbatches (replicated)
+        inv0, equiv0 = jax.vmap(
+            lambda b: model.apply(variables, b, False,
+                                  method=HydraModel.embed_block0)
+        )(mb)
+
+        stacked = _stack_layer_params(params, stats, L, S, k)
+
+        def apply_block(p_tree, inv, equiv, b):
+            """Re-apply the model's conv_block(1) with this layer's params
+            substituted — the scanned pipeline body."""
+            sub_params = dict(params, **{"graph_convs_1": p_tree["conv"]})
+            sub_vars = {"params": sub_params}
+            if "norm_p" in p_tree:
+                sub_params["feature_norm_1"] = p_tree["norm_p"]
+            if stats or "norm_s" in p_tree:
+                sub_stats = dict(stats)
+                if "norm_s" in p_tree:
+                    sub_stats["feature_norm_1"] = p_tree["norm_s"]
+                sub_vars["batch_stats"] = sub_stats
+            return model.apply(sub_vars, 1, inv, equiv, b, False,
+                               method=HydraModel.conv_block)
+
+        def stage_fn(my_params, inv0, equiv0, mb):
+            my_params = jax.tree.map(lambda x: x[0], my_params)  # [k, ...]
+            sidx = jax.lax.axis_index(STAGE_AXIS)
+            T = M + S - 1
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            def tick(carry, t):
+                inv_c, equiv_c = carry
+                m = jnp.clip(t - sidx, 0, M - 1)
+                b = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, False), mb
+                )
+                fresh_inv = jax.lax.dynamic_index_in_dim(inv0, m, 0, False)
+                fresh_equiv = jax.lax.dynamic_index_in_dim(equiv0, m, 0, False)
+                inv_in = jnp.where(sidx == 0, fresh_inv, inv_c)
+                equiv_in = jnp.where(sidx == 0, fresh_equiv, equiv_c)
+
+                def lay(c, p):
+                    return apply_block(p, c[0], c[1], b), None
+
+                (inv_out, equiv_out), _ = jax.lax.scan(
+                    lay, (inv_in, equiv_in), my_params
+                )
+                send = jax.tree.map(
+                    lambda a: jax.lax.ppermute(a, STAGE_AXIS, perm),
+                    (inv_out, equiv_out),
+                )
+                # only the last stage's result is the stack output; psum
+                # broadcasts it (other stages contribute zeros)
+                is_last = (sidx == S - 1).astype(inv_out.dtype)
+                y = jax.lax.psum((inv_out * is_last, equiv_out * is_last),
+                                 STAGE_AXIS)
+                return send, y
+
+            zero = (jnp.zeros_like(inv0[0]), jnp.zeros_like(equiv0[0]))
+            _, ys = jax.lax.scan(tick, zero, jnp.arange(T))
+            # microbatch m completes at tick m + S - 1
+            return jax.tree.map(lambda a: a[S - 1 : S - 1 + M], ys)
+
+        from jax.experimental.shard_map import shard_map
+
+        inv, equiv = shard_map(
+            stage_fn,
+            mesh=mesh,
+            in_specs=(P(STAGE_AXIS), P(), P(), P()),
+            out_specs=P(),
+            check_rep=False,
+        )(stacked, inv0, equiv0, mb)
+        return inv, equiv
+
+    return forward
+
+
+def make_pipelined_train_step(
+    model: HydraModel, optimizer, mesh: Mesh, n_micro: int,
+    compute_dtype=jnp.float32,
+):
+    """Jitted pipelined train step: (state, microbatches[M, ...]) ->
+    (state, metrics). Loss is the graph-weighted mean over microbatches,
+    the same bookkeeping as the data-parallel step."""
+    encode = make_pipelined_forward(model, mesh, n_micro)
+
+    def loss_fn(params, batch_stats, mb: GraphBatch):
+        c_params = _cast_floats(params, compute_dtype)
+        c_mb = _cast_floats(mb, compute_dtype)
+        variables = {"params": c_params, "batch_stats": batch_stats}
+        inv, equiv = encode(variables, c_mb)
+
+        def per_micro(inv_m, equiv_m, b, b_raw):
+            pred = model.apply(variables, inv_m, equiv_m, b, False,
+                               method=HydraModel.decode)
+            pred = _cast_floats(pred, jnp.float32)
+            tot, tasks = model.loss(pred, b_raw)
+            ng = b_raw.graph_mask.sum()
+            return tot * ng, jnp.stack(tasks) * ng, ng
+
+        tots, tasks, ngs = jax.vmap(per_micro)(inv, equiv, c_mb, mb)
+        denom = jnp.maximum(ngs.sum(), 1.0)
+        return tots.sum() / denom, (tasks.sum(axis=0) / denom, ngs.sum())
+
+    from ..train.step import donate_state_argnums as _donate
+
+    @partial(jax.jit, donate_argnums=_donate())
+    def train_step(state: TrainState, mb: GraphBatch):
+        (loss, (tasks, ng)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.batch_stats, mb
+        )
+        from ..train.step import freeze_conv_grads
+
+        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
+        updates, new_opt_state = optimizer.update(grads, state.opt_state,
+                                                  state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params,
+            batch_stats=state.batch_stats,  # frozen under pipelining
+            opt_state=new_opt_state,
+            step=state.step + 1,
+        )
+        return new_state, {"loss": loss, "tasks_loss": tasks, "num_graphs": ng}
+
+    return train_step
+
+
+def put_microbatches(mb: GraphBatch, mesh: Mesh) -> GraphBatch:
+    """Place a [M, ...] stacked GraphBatch replicated over the stage mesh."""
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sh), mb)
