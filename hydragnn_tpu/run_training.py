@@ -252,6 +252,12 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             print_distributed(verbosity, f"visualization failed: {e}")
 
     tr.print_timers(verbosity)
+    if verbosity >= 2:
+        # process-0 local devices only (the reference prints per rank,
+        # distributed.py:566-581; here other hosts' chips are not covered)
+        from .utils.print_utils import device_memory_summary
+
+        print_distributed(verbosity, f"[memory host0] {device_memory_summary()}")
     return state, model, config
 
 

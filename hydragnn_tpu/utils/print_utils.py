@@ -33,6 +33,31 @@ def print_distributed(verbosity_level: int, *args, **kwargs):
         print(*args, **kwargs)
 
 
+def device_memory_summary() -> str:
+    """Per-device HBM usage: current and peak bytes in use (the reference's
+    per-rank peak-GPU-memory print, ``distributed.py:566-581``; on TPU the
+    stats come from the PJRT allocator, on CPU they're unavailable)."""
+    try:
+        import jax
+
+        lines = []
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", lambda: None)() or {}
+            in_use = stats.get("bytes_in_use")
+            peak = stats.get("peak_bytes_in_use")
+            if in_use is None and peak is None:
+                continue
+            fields = []
+            if in_use is not None:
+                fields.append(f"in_use {in_use / 2**20:.0f} MiB")
+            if peak is not None:
+                fields.append(f"peak {peak / 2**20:.0f} MiB")
+            lines.append(f"dev{d.id}: " + ", ".join(fields))
+        return "; ".join(lines) or "device memory stats unavailable (CPU backend)"
+    except Exception as e:  # never break a training epilogue over telemetry
+        return f"device memory stats unavailable ({e})"
+
+
 def iterate_tqdm(iterable, verbosity_level: int, desc: str = "", total=None):
     """Progress-bar iteration at verbosity >= 2 (reference ``iterate_tqdm``);
     falls back to the plain iterable (tqdm may not be installed)."""

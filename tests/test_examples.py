@@ -94,6 +94,20 @@ def test_example_multidataset_packed(tmp_path):
     assert "epoch 0" in out2
 
 
+def test_example_uv_spectrum_smooth_and_discrete():
+    """DFTB UV-spectrum driver: wide spectrum head + two-head discrete mode."""
+    out = run_example(
+        ["examples/dftb_uv_spectrum/train.py", "--mode", "smooth", "--bins", "48",
+         "--molecules", "48", "--epochs", "2", "--batch", "8"]
+    )
+    assert "spectrum RMSE (48 bins)" in out
+    out2 = run_example(
+        ["examples/dftb_uv_spectrum/train.py", "--mode", "discrete", "--lines", "6",
+         "--molecules", "48", "--epochs", "2", "--batch", "8"]
+    )
+    assert "energies RMSE" in out2 and "strengths RMSE" in out2
+
+
 def test_example_multidataset_hpo(tmp_path):
     """GFM HPO driver: concurrent subprocess trials over packed stores."""
     d = str(tmp_path / "gfmhpo")

@@ -189,3 +189,10 @@ def test_compile_cache_enable(tmp_path, monkeypatch):
     monkeypatch.setenv("HYDRAGNN_COMPILE_CACHE", "0")
     monkeypatch.setattr(cc, "_enabled", False)
     assert cc.enable_compile_cache() is None
+
+
+def test_device_memory_summary_is_robust():
+    from hydragnn_tpu.utils.print_utils import device_memory_summary
+
+    s = device_memory_summary()
+    assert isinstance(s, str) and s  # CPU backend: explanatory fallback text
