@@ -340,6 +340,7 @@ class ModelSpec:
     force_weight: float = 0.0
     freeze_conv_layers: bool = False
     initial_bias: float | None = None
+    sync_batch_norm: bool = False
     conv_checkpointing: bool = False
     var_output: bool = False
     graph_size_variable: bool = False
@@ -436,6 +437,8 @@ class ModelSpec:
             force_weight=float(arch.get("force_weight", 0.0)),
             freeze_conv_layers=bool(arch.get("freeze_conv_layers", False)),
             initial_bias=arch.get("initial_bias"),
+            # reference spelling: Architecture.SyncBatchNorm (run_training.py:108)
+            sync_batch_norm=bool(arch.get("SyncBatchNorm", False)),
             conv_checkpointing=bool(training.get("conv_checkpointing", False)),
             var_output=training.get("loss_function_type") == "GaussianNLLLoss",
             graph_size_variable=bool(arch.get("graph_size_variable", False)),

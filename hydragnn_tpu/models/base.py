@@ -39,6 +39,7 @@ from ..graphs.graph import GraphBatch
 from ..graphs import segment
 from .common import (
     MLP,
+    SYNC_BN_AXIS,
     MaskedBatchNorm,
     get_activation,
     get_loss,
@@ -142,8 +143,16 @@ class HydraModel(nn.Module):
             conv_cls(spec=spec, layer=i) for i in range(spec.num_conv_layers)
         ]
         # some stacks (SchNet) use identity feature layers in the reference
+        # SyncBatchNorm (reference distributed.py:415-416, config key
+        # Architecture.SyncBatchNorm): stats pmean'd over the axis the SPMD
+        # steps bind; requires running under a parallel step's vmap
+        bn_axis = SYNC_BN_AXIS if spec.sync_batch_norm else None
         self.feature_layers = [
-            (MaskedBatchNorm(name=f"feature_norm_{i}") if use_feature_norm else None)
+            (
+                MaskedBatchNorm(name=f"feature_norm_{i}", axis_name=bn_axis)
+                if use_feature_norm
+                else None
+            )
             for i in range(spec.num_conv_layers)
         ]
 

@@ -117,6 +117,10 @@ class MLP(nn.Module):
         return x
 
 
+# the vmap/shard axis SPMD steps bind for cross-device stat syncing
+SYNC_BN_AXIS = "sync_bn"
+
+
 class MaskedBatchNorm(nn.Module):
     """BatchNorm over valid rows only (padding excluded from statistics).
 

@@ -28,7 +28,7 @@ from ..config.schema import EDGE_MODELS, ModelSpec
 from ..graphs.graph import GraphBatch
 from ..graphs import segment
 from .base import CONV_REGISTRY
-from .common import MaskedBatchNorm, get_activation
+from .common import SYNC_BN_AXIS, MaskedBatchNorm, get_activation
 
 
 def _positions_in_graph(batch: GraphBatch, n_max: int):
@@ -215,7 +215,7 @@ class GPSConv(nn.Module):
         h_local = drop(h_local, deterministic=not train)
         if h_local.shape[-1] == inv.shape[-1]:
             h_local = h_local + inv  # residual
-        h_local = MaskedBatchNorm(name="norm1")(h_local, batch.node_mask, train)
+        h_local = MaskedBatchNorm(name="norm1", axis_name=(SYNC_BN_AXIS if spec.sync_batch_norm else None))(h_local, batch.node_mask, train)
 
         attn_type = spec.global_attn_type or "multihead"
         if attn_type == "performer":
@@ -232,7 +232,7 @@ class GPSConv(nn.Module):
         h_attn = attn_mod(inv, batch, train)
         h_attn = drop(h_attn, deterministic=not train)
         h_attn = h_attn + inv  # residual
-        h_attn = MaskedBatchNorm(name="norm2")(h_attn, batch.node_mask, train)
+        h_attn = MaskedBatchNorm(name="norm2", axis_name=(SYNC_BN_AXIS if spec.sync_batch_norm else None))(h_attn, batch.node_mask, train)
 
         if h_local.shape[-1] != h_attn.shape[-1]:
             h_local = nn.Dense(h_attn.shape[-1], name="local_proj")(h_local)
@@ -243,5 +243,5 @@ class GPSConv(nn.Module):
         mlp = nn.Dense(out.shape[-1], name="mlp_1")(mlp)
         mlp = drop(mlp, deterministic=not train)
         out = out + mlp
-        out = MaskedBatchNorm(name="norm3")(out, batch.node_mask, train)
+        out = MaskedBatchNorm(name="norm3", axis_name=(SYNC_BN_AXIS if spec.sync_batch_norm else None))(out, batch.node_mask, train)
         return out, equiv

@@ -137,35 +137,54 @@ def make_mlip_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32
 
     def loss_fn(params, batch_stats, batch: GraphBatch, dropout_rng):
         c_params = _cast_floats(params, compute_dtype)
-        c_batch = _cast_floats(batch, compute_dtype)
 
-        def total_energy(pos):
-            # train-mode forward (dropout + batch-stat updates, matching the
-            # reference's autocast train forward); the SAME dropout mask is
-            # shared by the energy and its position-gradient (one rng per step)
-            b = c_batch.replace(pos=pos)
-            pred, updates = model.apply(
-                {"params": c_params, "batch_stats": batch_stats},
-                b,
-                train=True,
-                mutable=["batch_stats"],
-                rngs={"dropout": dropout_rng},
+        def compute(c_batch, b_raw, rng):
+            def total_energy(pos):
+                # train-mode forward (dropout + batch-stat updates, matching
+                # the reference's autocast train forward); the SAME dropout
+                # mask is shared by the energy and its position-gradient
+                b = c_batch.replace(pos=pos)
+                pred, updates = model.apply(
+                    {"params": c_params, "batch_stats": batch_stats},
+                    b,
+                    train=True,
+                    mutable=["batch_stats"],
+                    rngs={"dropout": rng},
+                )
+                if spec.var_output:
+                    pred = pred[0]
+                if spec.output_type[0] == "node":
+                    node_e = pred[0] * b.node_mask[:, None]
+                    graph_e = segment.segment_sum(node_e[:, 0], b.batch, b.num_graphs)
+                else:
+                    graph_e = pred[0][:, 0]
+                graph_e = (graph_e * b_raw.graph_mask).astype(jnp.float32)
+                return graph_e.sum(), (graph_e, updates["batch_stats"])
+
+            (_, (graph_e, new_stats)), grad_pos = jax.value_and_grad(
+                total_energy, has_aux=True
+            )(c_batch.pos)
+            forces = (-grad_pos * b_raw.node_mask[:, None]).astype(jnp.float32)
+            tot, tasks = energy_force_loss(spec, graph_e, forces, b_raw)
+            return tot, jnp.stack(tasks), new_stats
+
+        if spec.sync_batch_norm:
+            # size-1 vmap binds the sync axis (pmean = identity) so
+            # SyncBatchNorm configs run unchanged on one device
+            from .common import SYNC_BN_AXIS
+
+            tot, tasks, new_stats = jax.vmap(compute, axis_name=SYNC_BN_AXIS)(
+                jax.tree.map(lambda x: x[None], _cast_floats(batch, compute_dtype)),
+                jax.tree.map(lambda x: x[None], batch),
+                dropout_rng[None],
             )
-            if spec.var_output:
-                pred = pred[0]
-            if spec.output_type[0] == "node":
-                node_e = pred[0] * b.node_mask[:, None]
-                graph_e = segment.segment_sum(node_e[:, 0], b.batch, b.num_graphs)
-            else:
-                graph_e = pred[0][:, 0]
-            graph_e = (graph_e * batch.graph_mask).astype(jnp.float32)
-            return graph_e.sum(), (graph_e, updates["batch_stats"])
-
-        (_, (graph_e, new_stats)), grad_pos = jax.value_and_grad(
-            total_energy, has_aux=True
-        )(c_batch.pos)
-        forces = (-grad_pos * batch.node_mask[:, None]).astype(jnp.float32)
-        tot, tasks = energy_force_loss(spec, graph_e, forces, batch)
+            tot = tot[0]
+            tasks = tasks[0]
+            new_stats = jax.tree.map(lambda x: x[0], new_stats)
+        else:
+            tot, tasks, new_stats = compute(
+                _cast_floats(batch, compute_dtype), batch, dropout_rng
+            )
         return tot, (tasks, new_stats)
 
     from ..train.step import donate_state_argnums
@@ -189,7 +208,7 @@ def make_mlip_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32
         )
         return new_state, {
             "loss": tot,
-            "tasks_loss": jnp.stack(tasks),
+            "tasks_loss": jnp.asarray(tasks),
             "num_graphs": batch.graph_mask.sum(),
         }
 

@@ -151,6 +151,13 @@ def make_edge_sharded_train_step(
     """Training step over edge-sharded batches: identical contract to
     ``make_train_step`` — XLA inserts the node-accumulator all-reduces and
     the gradient psum from the shardings alone."""
+    if model.spec.sync_batch_norm:
+        raise ValueError(
+            "SyncBatchNorm is not supported with edge_sharding: the graph is "
+            "ONE giant sample split across devices (there is no per-device "
+            "batch whose statistics could be synced); feature norms already "
+            "see the full node set"
+        )
 
     def loss_fn(params, batch_stats, batch: GraphBatch, dropout_rng):
         c_params = _cast_floats(params, compute_dtype)

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..graphs.graph import GraphBatch
 from ..models.base import HydraModel
+from ..models.common import SYNC_BN_AXIS
 from ..train.step import TrainState, _cast_floats, donate_state_argnums as _donate
 from .mesh import DATA_AXIS, fsdp_param_specs
 
@@ -157,7 +158,7 @@ def make_parallel_train_step(
             ng = b.graph_mask.sum()
             return tot * ng, jnp.stack(tasks) * ng, ng, updates["batch_stats"]
 
-        tots, tasks, ngs, new_stats = jax.vmap(per_device)(c_batches, dev_rngs)
+        tots, tasks, ngs, new_stats = jax.vmap(per_device, axis_name=SYNC_BN_AXIS)(c_batches, dev_rngs)
         denom = jnp.maximum(ngs.sum(), 1.0)
         loss = tots.sum() / denom
         # running stats: average replicas (reference default — SyncBatchNorm off)
@@ -202,7 +203,7 @@ def make_parallel_eval_step(model: HydraModel, mesh: Mesh, compute_dtype=jnp.flo
             ng = b.graph_mask.sum()
             return tot * ng, jnp.stack(tasks) * ng, jnp.stack(sses), jnp.stack(counts), ng
 
-        tots, tasks, sses, counts, ngs = jax.vmap(per_device)(c_batches)
+        tots, tasks, sses, counts, ngs = jax.vmap(per_device, axis_name=SYNC_BN_AXIS)(c_batches)
         denom = jnp.maximum(ngs.sum(), 1.0)
         return {
             "loss": tots.sum() / denom,
@@ -247,7 +248,7 @@ def make_parallel_mlip_eval_step(model: HydraModel, mesh: Mesh, compute_dtype=jn
                 ng,
             )
 
-        tots, tasks, sses, counts, ngs = jax.vmap(per_device)(c_batches, batches)
+        tots, tasks, sses, counts, ngs = jax.vmap(per_device, axis_name=SYNC_BN_AXIS)(c_batches, batches)
         denom = jnp.maximum(ngs.sum(), 1.0)
         return {
             "loss": tots.sum() / denom,
@@ -304,7 +305,7 @@ def _make_parallel_mlip_train_step(
             ng = b_raw.graph_mask.sum()
             return tot * ng, jnp.stack(tasks) * ng, ng, new_stats
 
-        tots, tasks, ngs, new_stats = jax.vmap(per_device)(c_batches, batches, dev_rngs)
+        tots, tasks, ngs, new_stats = jax.vmap(per_device, axis_name=SYNC_BN_AXIS)(c_batches, batches, dev_rngs)
         denom = jnp.maximum(ngs.sum(), 1.0)
         new_stats = jax.tree.map(lambda x: x.mean(axis=0), new_stats)
         return tots.sum() / denom, (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)

@@ -125,13 +125,30 @@ def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
     def loss_fn(params, batch_stats, batch: GraphBatch, dropout_rng):
         c_params = _cast_floats(params, compute_dtype)
         c_batch = _cast_floats(batch, compute_dtype)
-        outputs, updates = model.apply(
-            {"params": c_params, "batch_stats": batch_stats},
-            c_batch,
-            train=True,
-            mutable=["batch_stats"],
-            rngs={"dropout": dropout_rng},
-        )
+
+        def apply_train(b, rng):
+            return model.apply(
+                {"params": c_params, "batch_stats": batch_stats},
+                b,
+                train=True,
+                mutable=["batch_stats"],
+                rngs={"dropout": rng},
+            )
+
+        if model.spec.sync_batch_norm:
+            # bind the sync axis as a size-1 vmap: pmean over it is the
+            # identity, so SyncBatchNorm configs run unchanged on one device
+            # (the reference's convert_sync_batchnorm is likewise a no-op at
+            # world size 1)
+            from ..models.common import SYNC_BN_AXIS
+
+            outputs, updates = jax.vmap(apply_train, axis_name=SYNC_BN_AXIS)(
+                jax.tree.map(lambda x: x[None], c_batch), dropout_rng[None]
+            )
+            outputs = jax.tree.map(lambda x: x[0], outputs)
+            updates = jax.tree.map(lambda x: x[0], updates)
+        else:
+            outputs, updates = apply_train(c_batch, dropout_rng)
         pred = _cast_floats(outputs, jnp.float32)
         tot, tasks = model.loss(pred, batch)
         return tot, (tasks, updates["batch_stats"])
