@@ -46,7 +46,10 @@ def main():
     ap.add_argument("--make-synthetic", type=str, default=None, metavar="DIR")
     ap.add_argument("--arch", type=str, default="EGNN",
                     choices=["EGNN", "PAINN", "MACE", "SchNet"])
-    ap.add_argument("--configs", type=int, default=100)
+    ap.add_argument("--configs", type=int, default=100,
+                    help="structures to synthesize with --make-synthetic")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="convert at most N structures from --data")
     ap.add_argument("--epochs", type=int, default=10)
     ap.add_argument("--batch", type=int, default=8)
     args = ap.parse_args()
@@ -58,8 +61,21 @@ def main():
         outdir = args.make_synthetic or "./oc20_synthetic"
         path = make_synthetic(outdir, args.configs)
         print(f"synthesized S2EF store at {path}")
-    else:
+    elif args.data.endswith(".gpk"):
         path = args.data
+    else:
+        # real public data (extxyz / ASE / LMDB / cfg): convert once to the
+        # packed store next to the input, then train from the mmap store
+        from hydragnn_tpu.datasets.convert import convert_to_packed
+
+        path = os.path.splitext(args.data)[0] + ".gpk"
+        if not os.path.exists(path):
+            n = convert_to_packed(
+                args.data, path, radius=5.0, max_neighbours=40, limit=args.limit,
+            )
+            print(f"converted {n} structures from {args.data} -> {path}")
+        else:
+            print(f"reusing existing converted store {path}")
 
     store = GlobalShuffleStore(path)
     print(f"dataset: {store.attrs.get('dataset_name')}, {len(store)} structures")

@@ -43,9 +43,28 @@ def synthetic_molecules(n: int, seed: int = 0):
     return samples
 
 
+def _is_qm9_flavor(path, parse_comment) -> bool:
+    """Peek at the first frame's comment line: QM9 raw files carry a 'gdb'
+    property line; ordinary (ext)xyz exports do not."""
+    if os.path.isdir(path):
+        names = sorted(n for n in os.listdir(path) if n.endswith(".xyz"))
+        if not names:
+            return False
+        path = os.path.join(path, names[0])
+    with open(path) as f:
+        f.readline()
+        return parse_comment(f.readline()) is not None
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--data", default=None, help="directory of QM9 .xyz files")
+    ap.add_argument("--data", default=None,
+                    help="QM9 raw data: a directory of .xyz files or one "
+                         "multi-frame .xyz (the real public format — 'gdb' "
+                         "property lines are auto-detected)")
+    ap.add_argument("--target", default="U0",
+                    help="QM9 property to regress (A B C mu alpha homo lumo "
+                         "gap r2 zpve U0 U H G Cv)")
     ap.add_argument("--epochs", type=int, default=None)
     ap.add_argument("--samples", type=int, default=1000)
     args = ap.parse_args()
@@ -58,10 +77,28 @@ def main():
         config["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
 
     samples = None
-    if args.data and os.path.isdir(args.data):
+    if args.data and os.path.exists(args.data):
+        from hydragnn_tpu.datasets.xyz import _QM9_PROPS, _parse_qm9_comment
+
         config["Dataset"]["path"] = {"total": args.data}
+        if _is_qm9_flavor(args.data, _parse_qm9_comment):
+            # real QM9 files carry the full 15-property table columnar in
+            # graph_table (xyz.py auto-detection); select one target
+            config["Dataset"]["graph_features"] = {
+                "name": list(_QM9_PROPS),
+                "dim": [1] * len(_QM9_PROPS),
+                "column_index": list(range(len(_QM9_PROPS))),
+            }
+            voi = config["NeuralNetwork"]["Variables_of_interest"]
+            voi["output_names"] = [args.target]
+            voi["output_index"] = [list(_QM9_PROPS).index(args.target)]
+        elif args.target != "U0":
+            ap.error(
+                "--target only applies to QM9-format files (gdb property "
+                "lines); this input carries a single energy column"
+            )
     else:
-        print("no --data directory; generating synthetic QM9-like molecules")
+        print("no --data; generating synthetic QM9-like molecules")
         samples = synthetic_molecules(args.samples)
 
     state, model, cfg = hydragnn_tpu.run_training(config, samples=samples)

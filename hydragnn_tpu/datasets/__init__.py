@@ -7,6 +7,9 @@ from .pickledataset import SimplePickleDataset, SimplePickleWriter
 from .packed import PackedDataset, PackedWriter
 
 
+import os
+
+
 def load_raw_dataset(config: dict):
     """Dispatch on ``Dataset.format`` to a raw loader (reference
     ``transform_raw_data_to_serialized`` + per-format loaders,
@@ -19,6 +22,8 @@ def load_raw_dataset(config: dict):
     if fmt == "lsms":
         return load_lsms_dir(path, charge_density_update=ds.get("charge_density", False))
     if fmt == "xyz":
+        if os.path.isfile(path):
+            return read_xyz_file(path)
         return load_xyz_dir(path)
     if fmt == "cfg":
         return load_cfg_dir(path)

@@ -24,7 +24,7 @@ from typing import Iterable, Sequence
 
 import numpy as np
 
-from .graph import GraphBatch, GraphSample
+from .graph import BatchMeta, GraphBatch, GraphSample
 
 
 def _round_up(value: int, multiple: int) -> int:
@@ -34,15 +34,27 @@ def _round_up(value: int, multiple: int) -> int:
 class PadSpec:
     """A static padding bucket: (n_node, n_edge, n_graph[, n_triplet]) with
     n_graph including the trailing dummy padding graph. ``n_triplet`` is 0
-    unless the pipeline attaches DimeNet triplets."""
+    unless the pipeline attaches DimeNet triplets.
 
-    __slots__ = ("n_node", "n_edge", "n_graph", "n_triplet")
+    ``node_cap``: dataset-wide upper bound on PER-GRAPH node count (0 =
+    unknown). Collate certifies each batch against it so GPS can choose
+    dense-block vs flat attention at trace time (``BatchMeta.max_n_node``)."""
 
-    def __init__(self, n_node: int, n_edge: int, n_graph: int, n_triplet: int = 0):
+    __slots__ = ("n_node", "n_edge", "n_graph", "n_triplet", "node_cap")
+
+    def __init__(
+        self,
+        n_node: int,
+        n_edge: int,
+        n_graph: int,
+        n_triplet: int = 0,
+        node_cap: int = 0,
+    ):
         self.n_node = int(n_node)
         self.n_edge = int(n_edge)
         self.n_graph = int(n_graph)
         self.n_triplet = int(n_triplet)
+        self.node_cap = int(node_cap)
 
     def as_tuple(self) -> tuple[int, int, int, int]:
         return (self.n_node, self.n_edge, self.n_graph, self.n_triplet)
@@ -84,7 +96,8 @@ def compute_pad_spec(
         else 0
     )
     return PadSpec(
-        n_node=n_node, n_edge=n_edge, n_graph=batch_size + 1, n_triplet=n_triplet
+        n_node=n_node, n_edge=n_edge, n_graph=batch_size + 1, n_triplet=n_triplet,
+        node_cap=int(max_nodes),
     )
 
 
@@ -198,6 +211,41 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
         n_node=n_node, dataset_id=dataset_id,
         idx_kj=idx_kj, idx_ji=idx_ji, triplet_mask=triplet_mask,
         pe=pe, rel_pe=rel_pe, z=z,
+        meta=_batch_meta(senders, receivers, batch, n_node, N, G, pad.node_cap),
+    )
+
+
+def _batch_meta(
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    batch: np.ndarray,
+    n_node: np.ndarray,
+    N: int,
+    G: int,
+    node_cap: int,
+) -> BatchMeta:
+    """Certify the fused-kernel layout contracts for this batch host-side, so
+    every kernel-vs-fallback choice downstream is trace-time static (see
+    ``BatchMeta``). ``max_n_node`` is the bucket's dataset-wide ``node_cap``
+    whenever this batch honors it (the stable common case — one treedef for
+    the whole run); an outlier batch gets its own power-of-two bound, keeping
+    the number of distinct treedefs (→ retraces) at O(log N)."""
+    from ..ops.fused_scatter import segment_window, window_fits_host
+
+    largest = int(n_node.max()) if n_node.size else 0
+    if node_cap and largest <= node_cap:
+        bound = node_cap
+    else:
+        bound = max(1 << max(largest - 1, 0).bit_length(), 8)
+    return BatchMeta(
+        gs_fits=(
+            window_fits_host(senders, N, 256, 256)
+            and window_fits_host(receivers, N, 256, 256)
+        ),
+        recv_fits=window_fits_host(receivers, N, segment_window(N), 256),
+        send_fits=window_fits_host(senders, N, segment_window(N), 256),
+        pool_fits=window_fits_host(batch, G, segment_window(G), 256),
+        max_n_node=bound,
     )
 
 
@@ -245,6 +293,7 @@ def compute_pad_buckets(
             n_triplet=min(_round_up(int(t), edge_multiple), worst.n_triplet)
             if worst.n_triplet
             else 0,
+            node_cap=worst.node_cap,
         )
         if spec not in buckets and spec != worst:
             buckets.append(spec)

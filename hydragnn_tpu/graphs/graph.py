@@ -32,6 +32,60 @@ import numpy as np
 Array = Any  # np.ndarray on host, jax.Array on device
 
 
+class BatchMeta(NamedTuple):
+    """Host-verified STATIC layout guarantees for a batch, decided at collate
+    time and carried as pytree *aux data* (not a leaf): two batches with
+    different guarantees have different treedefs, so ``jit`` automatically
+    traces each combination once and every in-program fast-path/fallback
+    choice below becomes trace-time static — no ``lax.cond`` that would
+    degrade to executing BOTH branches under ``vmap`` (the SPMD path).
+
+    ``None`` for any field means "unknown" (e.g. a hand-built batch): the
+    consuming op keeps its dynamic in-program fallback.
+
+    - ``gs_fits``: every 256-edge block of (senders, receivers) spans a node
+      window ≤ 256 — the fused gather-scatter kernel's layout contract
+      (``ops.fused_scatter.fused_gather_scatter``), valid for both the fwd
+      and the transposed bwd kernel since the check covers both arrays.
+    - ``recv_fits`` / ``send_fits`` / ``pool_fits``: the scatter-only kernel's
+      contract (window 128) for edge→node reductions keyed by receivers /
+      senders and node→graph pooling keyed by ``batch``.
+    - ``max_n_node``: static upper bound on per-graph node count (rounded up
+      to a power of two so retrace count stays O(log N)); lets GPS pick
+      dense-block vs flat attention at trace time.
+    """
+
+    gs_fits: bool | None = None
+    recv_fits: bool | None = None
+    send_fits: bool | None = None
+    pool_fits: bool | None = None
+    max_n_node: int | None = None
+
+    @staticmethod
+    def merge(metas: "list[BatchMeta | None]") -> "BatchMeta | None":
+        """Conservative merge for stacked per-device batches: a guarantee
+        holds for the stack only if it holds for every member."""
+        if any(m is None for m in metas) or not metas:
+            return None
+
+        def all_or_none(vals):
+            if any(v is None for v in vals):
+                return None
+            return all(vals)
+
+        return BatchMeta(
+            gs_fits=all_or_none([m.gs_fits for m in metas]),
+            recv_fits=all_or_none([m.recv_fits for m in metas]),
+            send_fits=all_or_none([m.send_fits for m in metas]),
+            pool_fits=all_or_none([m.pool_fits for m in metas]),
+            max_n_node=(
+                None
+                if any(m.max_n_node is None for m in metas)
+                else max(m.max_n_node for m in metas)
+            ),
+        )
+
+
 class GraphBatch(NamedTuple):
     """A batch of graphs padded to static shapes.
 
@@ -89,6 +143,9 @@ class GraphBatch(NamedTuple):
     pe: Array
     rel_pe: Array
     z: Array
+    # STATIC aux metadata (BatchMeta | None) — part of the treedef, not a
+    # leaf; see the explicit pytree registration below the class.
+    meta: Any = None
 
     # -- static helpers -------------------------------------------------------
     @property
@@ -118,6 +175,38 @@ class GraphBatch(NamedTuple):
 
     def replace(self, **kwargs) -> "GraphBatch":
         return self._replace(**kwargs)
+
+    def seg_hint(self, segment_ids) -> bool | None:
+        """Static window-fit hint for a segment reduction keyed by WHICH id
+        array it uses — matched by object identity, which is stable for
+        attribute reads off this NamedTuple (including tracers inside jit).
+        Returns None (→ dynamic fallback) for unknown id arrays."""
+        m = self.meta
+        if m is None:
+            return None
+        if segment_ids is self.receivers:
+            return m.recv_fits
+        if segment_ids is self.senders:
+            return m.send_fits
+        if segment_ids is self.batch:
+            return m.pool_fits
+        return None
+
+
+# Data fields (leaves) vs static metadata (aux): explicit registration takes
+# precedence over JAX's built-in NamedTuple flattening, so ``meta`` rides the
+# treedef — ``jax.tree.map`` never touches it and ``jit`` keys traces on it.
+_DATA_FIELDS = GraphBatch._fields[:-1]
+assert GraphBatch._fields[-1] == "meta"
+
+jax.tree_util.register_pytree_with_keys(
+    GraphBatch,
+    lambda b: (
+        tuple((jax.tree_util.GetAttrKey(f), getattr(b, f)) for f in _DATA_FIELDS),
+        b.meta,
+    ),
+    lambda meta, children: GraphBatch(*children, meta=meta),
+)
 
 
 class GraphSample:

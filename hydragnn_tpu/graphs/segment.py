@@ -31,17 +31,25 @@ def _zero_empty(out: Array, identity: Array) -> Array:
     return jnp.where(out == identity, jnp.zeros_like(out), out)
 
 
-def segment_sum(data: Array, segment_ids: Array, num_segments: int) -> Array:
+def segment_sum(
+    data: Array, segment_ids: Array, num_segments: int, hints=None
+) -> Array:
     """Sum ``data`` rows into ``num_segments`` buckets by ``segment_ids``.
 
     2D float data routes through the Pallas windowed scatter-add kernel
     (``hydragnn_tpu.ops.fused_scatter``) when enabled — collated batches keep
     segment ids near-sorted, so each edge block touches a narrow node window.
-    A/B switch: ``HYDRAGNN_FUSED_SCATTER=0|1`` (default: on for TPU)."""
+    A/B switch: ``HYDRAGNN_FUSED_SCATTER=0|1`` (default: on for TPU).
+
+    ``hints``: the ``GraphBatch`` the ids came from, if available. Its static
+    ``BatchMeta`` (collate-certified window fits) turns the kernel-vs-XLA
+    choice into a trace-time decision — no ``lax.cond`` that would execute
+    both paths under ``vmap`` (the SPMD per-device step)."""
     from ..ops import fused_scatter
 
     if data.ndim == 2 and fused_scatter._auto_enabled():
-        return fused_scatter.fused_segment_sum(data, segment_ids, num_segments)
+        fits = hints.seg_hint(segment_ids) if hints is not None else None
+        return fused_scatter.fused_segment_sum(data, segment_ids, num_segments, fits)
     return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
 
 
@@ -52,16 +60,16 @@ def segment_count(segment_ids: Array, num_segments: int, weights: Array | None =
 
 
 def segment_mean(
-    data: Array, segment_ids: Array, num_segments: int, eps: float = 1e-12
+    data: Array, segment_ids: Array, num_segments: int, eps: float = 1e-12, hints=None
 ) -> Array:
     """Mean per segment; empty segments yield zeros (matches torch_scatter 'mean')."""
-    total = segment_sum(data, segment_ids, num_segments)
+    total = segment_sum(data, segment_ids, num_segments, hints)
     count = segment_count(segment_ids, num_segments)
     count = jnp.maximum(count, eps).astype(total.dtype)
     return total / count.reshape((-1,) + (1,) * (total.ndim - 1))
 
 
-def segment_max(data: Array, segment_ids: Array, num_segments: int) -> Array:
+def segment_max(data: Array, segment_ids: Array, num_segments: int, hints=None) -> Array:
     """Max per segment; empty segments yield 0 (PyG ``global_max_pool`` on empty
     graphs is undefined — we pick 0 so padded dummy graphs stay finite)."""
     out = jax.ops.segment_max(data, segment_ids, num_segments=num_segments)
@@ -71,7 +79,7 @@ def segment_max(data: Array, segment_ids: Array, num_segments: int) -> Array:
     return _zero_empty(out, identity)
 
 
-def segment_min(data: Array, segment_ids: Array, num_segments: int) -> Array:
+def segment_min(data: Array, segment_ids: Array, num_segments: int, hints=None) -> Array:
     out = jax.ops.segment_min(data, segment_ids, num_segments=num_segments)
     identity = None
     if not jnp.issubdtype(out.dtype, jnp.floating):
@@ -80,18 +88,18 @@ def segment_min(data: Array, segment_ids: Array, num_segments: int) -> Array:
 
 
 def segment_std(
-    data: Array, segment_ids: Array, num_segments: int, eps: float = 1e-5
+    data: Array, segment_ids: Array, num_segments: int, eps: float = 1e-5, hints=None
 ) -> Array:
     """Per-segment standard deviation (biased, matching PyG ``StdAggregation``
     used by PNA's 'std' aggregator)."""
-    mean = segment_mean(data, segment_ids, num_segments)
-    mean_sq = segment_mean(data * data, segment_ids, num_segments)
+    mean = segment_mean(data, segment_ids, num_segments, hints=hints)
+    mean_sq = segment_mean(data * data, segment_ids, num_segments, hints=hints)
     var = jnp.maximum(mean_sq - mean * mean, 0.0)
     return jnp.sqrt(var + eps)
 
 
 def segment_softmax(
-    logits: Array, segment_ids: Array, num_segments: int
+    logits: Array, segment_ids: Array, num_segments: int, hints=None
 ) -> Array:
     """Numerically-stable softmax within each segment (GAT attention weights).
 
@@ -105,16 +113,16 @@ def segment_softmax(
     seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, jnp.zeros_like(seg_max))
     shifted = logits - seg_max[segment_ids]
     exp = jnp.exp(shifted)
-    denom = segment_sum(exp, segment_ids, num_segments)
+    denom = segment_sum(exp, segment_ids, num_segments, hints)
     denom = jnp.maximum(denom, 1e-12)
     return exp / denom[segment_ids]
 
 
 def segment_normalize(
-    data: Array, segment_ids: Array, num_segments: int, eps: float = 1e-12
+    data: Array, segment_ids: Array, num_segments: int, eps: float = 1e-12, hints=None
 ) -> Array:
     """Divide each element by its segment's sum (degree-normalized aggregation)."""
-    denom = segment_sum(data, segment_ids, num_segments)
+    denom = segment_sum(data, segment_ids, num_segments, hints)
     denom = jnp.where(jnp.abs(denom) < eps, jnp.ones_like(denom), denom)
     return data / denom[segment_ids]
 
@@ -128,14 +136,16 @@ _POOL_FNS = {
 }
 
 
-def global_pool(kind: str, data: Array, segment_ids: Array, num_segments: int) -> Array:
+def global_pool(
+    kind: str, data: Array, segment_ids: Array, num_segments: int, hints=None
+) -> Array:
     """Graph-level readout: the reference's ``global_{mean,add,max}_pool``
     (``hydragnn/models/Base.py:147-170``) as one masked segment reduction."""
     try:
         fn = _POOL_FNS[kind]
     except KeyError:
         raise ValueError(f"Unknown pooling '{kind}'; expected one of {sorted(_POOL_FNS)}")
-    return fn(data, segment_ids, num_segments)
+    return fn(data, segment_ids, num_segments, hints=hints)
 
 
 def scatter_degree(

@@ -357,6 +357,7 @@ class HydraModel(nn.Module):
             x * batch.node_mask[:, None],
             batch.batch,
             batch.num_graphs,
+            hints=batch,
         )
         if (
             self.spec.use_graph_attr_conditioning

@@ -36,7 +36,7 @@ class CGCNNConv(nn.Module):
         gate = nn.sigmoid(nn.Dense(dim, name="lin_f")(z))
         core = nn.softplus(nn.Dense(dim, name="lin_s")(z))
         msg = gate * core * batch.edge_mask[:, None]
-        agg = segment.segment_sum(msg, batch.receivers, batch.num_nodes)
+        agg = segment.segment_sum(msg, batch.receivers, batch.num_nodes, hints=batch)
         out = inv + agg  # residual (aggr='add' in reference CGConv)
         if self.out_dim is not None and self.out_dim != dim:
             out = nn.Dense(self.out_dim, name="proj")(out)

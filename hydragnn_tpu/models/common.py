@@ -185,6 +185,7 @@ def equivariant_coordinate_update(
     hidden: int,
     tanh_bound: bool,
     name_prefix: str = "coord",
+    hints=None,
 ) -> Array:
     """Shared E(3) coordinate-update block used by EGNN and SchNet
     (reference ``E_GCL.coord_model`` / ``CFConv.coord_model``): per-edge scalar
@@ -207,6 +208,6 @@ def equivariant_coordinate_update(
     if tanh_bound:
         gate = jnp.tanh(gate)
     trans = jnp.clip(coord_diff * gate, -100.0, 100.0) * edge_mask[:, None]
-    agg = segment.segment_sum(trans, senders, num_nodes)
+    agg = segment.segment_sum(trans, senders, num_nodes, hints)
     cnt = segment.segment_sum(edge_mask, senders, num_nodes)
     return agg / jnp.maximum(cnt, 1.0)[:, None]

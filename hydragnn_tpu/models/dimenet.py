@@ -152,7 +152,7 @@ class DimeNetConv(nn.Module):
         # OutputPPBlock: rbf-gated edge -> node scatter
         g = nn.Dense(hidden, use_bias=False, name="out_lin_rbf")(rbf)
         x_gated = g * x_edge * batch.edge_mask[:, None]
-        node_x = segment.segment_sum(x_gated, batch.receivers, batch.num_nodes)
+        node_x = segment.segment_sum(x_gated, batch.receivers, batch.num_nodes, hints=batch)
         node_x = nn.Dense(spec.out_emb_size or 128, use_bias=False, name="out_lin_up")(
             node_x
         )

@@ -66,11 +66,11 @@ class EGNNConv(nn.Module):
         if equivariant:
             equiv = equiv + equivariant_coordinate_update(
                 m, coord_diff, batch.senders, batch.edge_mask, batch.num_nodes,
-                hidden, tanh_bound=True, name_prefix="coord_mlp",
+                hidden, tanh_bound=True, name_prefix="coord_mlp", hints=batch,
             )
 
         m_masked = m * batch.edge_mask[:, None]
-        agg = segment.segment_sum(m_masked, batch.senders, batch.num_nodes)
+        agg = segment.segment_sum(m_masked, batch.senders, batch.num_nodes, hints=batch)
         h = MLP(
             features=(hidden, out_dim),
             activation=spec.activation,

@@ -37,7 +37,7 @@ class GINConv(nn.Module):
 
         agg = gather_scatter_sum(
             inv, batch.senders, batch.receivers, batch.num_nodes,
-            weight=batch.edge_mask.astype(inv.dtype),
+            weight=batch.edge_mask.astype(inv.dtype), hints=batch,
         )
         out = MLP(
             features=(hidden, hidden),

@@ -92,24 +92,54 @@ class GraphMultiheadAttention(nn.Module):
         v = nn.Dense(self.channels, name="v")(h).reshape(N, H, Dh)
         if self.ring:
             # giant-graph path: K/V shards rotate around the mesh ring with
-            # an online softmax — O(N/D) peak memory, exact results
+            # an online softmax — O(N/D) peak memory, exact results. The user
+            # asked for ring explicitly, so never silently hand them the
+            # O(N²) flat path that defeats the point: an indivisible N is an
+            # error (pad the bucket node count to a mesh multiple), and a
+            # missing mesh warns loudly before degrading.
             from ..parallel.ring_attention import get_global_mesh, ring_attention
 
             mesh = get_global_mesh()
-            if mesh is not None and N % mesh.shape["data"] == 0:
+            if mesh is not None:
+                ring_dev = mesh.shape["data"]
+                if N % ring_dev:
+                    raise ValueError(
+                        f"global_attn_type 'ring' needs the padded node count "
+                        f"({N}) divisible by the mesh data axis ({ring_dev}); "
+                        f"pad the bucket n_node to a multiple of {ring_dev}"
+                    )
                 out = ring_attention(
                     q, k, v, batch.batch, batch.node_mask, mesh
                 )
                 return nn.Dense(self.channels, name="out")(
                     out.reshape(N, self.channels)
                 )
-        if self.n_max and self.n_max < N:
-            fits = jnp.all(batch.n_node <= self.n_max)
-            out = jax.lax.cond(
-                fits,
-                lambda: self._dense_attention(q, k, v, batch),
-                lambda: self._flat_attention(q, k, v, batch),
+            import warnings
+
+            warnings.warn(
+                "global_attn_type 'ring' requested but no global mesh is "
+                "published (parallel.ring_attention.set_global_mesh); falling "
+                "back to flat O(N^2) masked attention",
+                stacklevel=2,
             )
+        # dense-block vs exact flat attention: decided AT TRACE TIME whenever
+        # collate certified a per-graph size bound (BatchMeta.max_n_node) — a
+        # data-dependent lax.cond here lowers to select under vmap (the SPMD
+        # per-device step), which would compute BOTH attentions every step.
+        bound = batch.meta.max_n_node if batch.meta is not None else None
+        if self.n_max and self.n_max < N:
+            if bound is not None:
+                if bound <= self.n_max:
+                    out = self._dense_attention(q, k, v, batch)
+                else:
+                    out = self._flat_attention(q, k, v, batch)
+            else:
+                fits = jnp.all(batch.n_node <= self.n_max)
+                out = jax.lax.cond(
+                    fits,
+                    lambda: self._dense_attention(q, k, v, batch),
+                    lambda: self._flat_attention(q, k, v, batch),
+                )
         else:
             out = self._flat_attention(q, k, v, batch)
         return nn.Dense(self.channels, name="out")(out.reshape(N, self.channels))
@@ -169,9 +199,9 @@ class PerformerAttention(nn.Module):
 
         kv = segment.segment_sum(
             (kp[:, :, :, None] * v[:, :, None, :]).reshape(N, H * m * Dh),
-            batch.batch, G,
+            batch.batch, G, hints=batch,
         ).reshape(G, H, m, Dh)
-        z = segment.segment_sum(kp.reshape(N, H * m), batch.batch, G).reshape(G, H, m)
+        z = segment.segment_sum(kp.reshape(N, H * m), batch.batch, G, hints=batch).reshape(G, H, m)
 
         num = jnp.einsum("nhm,nhmd->nhd", qp, kv[batch.batch])
         den = jnp.einsum("nhm,nhm->nh", qp, z[batch.batch])

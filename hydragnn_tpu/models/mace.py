@@ -170,7 +170,7 @@ class MACEConv(nn.Module):
         }
         msgs = tensor_product(sender_feats, sh, node_ell, weights)
         agg = {
-            l: segment.segment_sum(m, batch.receivers, batch.num_nodes) / avg_nbr
+            l: segment.segment_sum(m, batch.receivers, batch.num_nodes, hints=batch) / avg_nbr
             for l, m in msgs.items()
         }
         agg = IrrepsLinear(C, node_ell, name="linear_post")(agg)

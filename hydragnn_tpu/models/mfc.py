@@ -38,7 +38,7 @@ class MFCConv(nn.Module):
 
         agg = gather_scatter_sum(
             inv, batch.senders, batch.receivers, N,
-            weight=batch.edge_mask.astype(inv.dtype),
+            weight=batch.edge_mask.astype(inv.dtype), hints=batch,
         )
         deg = segment.segment_sum(batch.edge_mask, batch.receivers, N)
         deg_idx = jnp.clip(deg.astype(jnp.int32), 0, max_deg)

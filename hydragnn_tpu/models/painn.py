@@ -60,7 +60,7 @@ class PainnMessage(nn.Module):
         v_msg = v[batch.receivers] * gate_v[:, None, :] + gate_edge[:, None, :] * unit_vec[:, :, None]
 
         em = batch.edge_mask
-        ds = segment.segment_sum(msg_s * em[:, None], batch.senders, batch.num_nodes)
+        ds = segment.segment_sum(msg_s * em[:, None], batch.senders, batch.num_nodes, hints=batch)
         dv = segment.segment_sum(
             v_msg * em[:, None, None], batch.senders, batch.num_nodes
         )

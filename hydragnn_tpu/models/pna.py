@@ -50,6 +50,7 @@ def degree_scaled_aggregate(
     aggregators=AGGREGATORS,
     scalers=SCALERS,
     avg_deg_lin: float | None = None,
+    hints=None,
 ) -> jax.Array:
     """[E, F] messages -> [N, len(aggr)*len(scalers)*F] aggregated features.
 
@@ -64,15 +65,15 @@ def degree_scaled_aggregate(
     outs = []
     for a in aggregators:
         if a == "mean":
-            outs.append(segment.segment_mean(msg_sum, receivers, num_nodes))
+            outs.append(segment.segment_mean(msg_sum, receivers, num_nodes, hints=hints))
         elif a == "min":
-            outs.append(segment.segment_min(msg, receivers, num_nodes))
+            outs.append(segment.segment_min(msg, receivers, num_nodes, hints=hints))
         elif a == "max":
-            outs.append(segment.segment_max(msg, receivers, num_nodes))
+            outs.append(segment.segment_max(msg, receivers, num_nodes, hints=hints))
         elif a == "std":
-            outs.append(segment.segment_std(msg, receivers, num_nodes))
+            outs.append(segment.segment_std(msg, receivers, num_nodes, hints=hints))
         elif a == "sum":
-            outs.append(segment.segment_sum(msg_sum, receivers, num_nodes))
+            outs.append(segment.segment_sum(msg_sum, receivers, num_nodes, hints))
         else:
             raise ValueError(f"unknown aggregator {a}")
     agg = jnp.concatenate(outs, axis=-1)  # [N, A*F]

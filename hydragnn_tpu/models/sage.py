@@ -33,7 +33,7 @@ class SAGEConv(nn.Module):
         N = batch.num_nodes
         total = gather_scatter_sum(
             inv, batch.senders, batch.receivers, N,
-            weight=batch.edge_mask.astype(inv.dtype),
+            weight=batch.edge_mask.astype(inv.dtype), hints=batch,
         )
         count = segment.segment_count(batch.receivers, N, weights=batch.edge_mask)
         agg = total / jnp.maximum(count, 1e-12).astype(total.dtype)[:, None]

@@ -67,7 +67,7 @@ class SchNetConv(nn.Module):
 
         agg = gather_scatter_sum(
             x, batch.senders, batch.receivers, batch.num_nodes,
-            weight=(w * batch.edge_mask[:, None]).astype(x.dtype),
+            weight=(w * batch.edge_mask[:, None]).astype(x.dtype), hints=batch,
         )
         out = nn.Dense(hidden, name="lin2")(agg)
 
@@ -77,7 +77,7 @@ class SchNetConv(nn.Module):
             coord_diff = vec / (dist[:, None] + 1.0)
             equiv = equiv + equivariant_coordinate_update(
                 w, coord_diff, batch.senders, batch.edge_mask, batch.num_nodes,
-                nf, tanh_bound=False, name_prefix="coord",
+                nf, tanh_bound=False, name_prefix="coord", hints=batch,
             )
 
         return out, equiv

@@ -201,6 +201,37 @@ def _pallas_gather_scatter(
     return out, fits
 
 
+def segment_window(num_segments: int) -> int:
+    """The window ``fused_segment_sum`` picks for a given segment count —
+    exposed so host-side certification (collate → BatchMeta) uses the exact
+    same value."""
+    return 128 if num_segments >= 128 else num_segments
+
+
+def window_fits_host(
+    ids: np.ndarray, num_nodes: int, window: int, block_edges: int
+) -> bool:
+    """Host (numpy) replica of the kernel's per-block window-fit check, with
+    the same pad-to-``block_edges`` convention ``fused_gather_scatter`` /
+    ``fused_segment_sum`` apply. Collate uses this to certify the layout
+    contract STATICALLY (``BatchMeta``), so the in-program ``lax.cond``
+    fallback — which ``vmap`` would turn into executing both branches —
+    never enters the traced program. Kept adjacent to ``_window_starts`` so
+    the two stay in lockstep (tests assert they agree)."""
+    ids = np.asarray(ids, np.int64)
+    e = ids.shape[0]
+    if e == 0:
+        return True
+    e_pad = -e % block_edges
+    if e_pad:
+        ids = np.concatenate([ids, np.full(e_pad, num_nodes - 1, np.int64)])
+    blocks = ids.reshape(-1, block_edges)
+    lo = blocks.min(axis=1)
+    hi = blocks.max(axis=1)
+    start = np.clip((lo // 8) * 8, 0, max(num_nodes - window, 0))
+    return bool(np.all(hi - start < window))
+
+
 def _static_ok(h, senders, num_nodes, window) -> bool:
     if pltpu is None:
         return False
@@ -213,34 +244,49 @@ def _static_ok(h, senders, num_nodes, window) -> bool:
     return True
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 5, 6, 7))
-def _fused(h, senders, receivers, num_nodes, weight, window, block_edges, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 5, 6, 7, 8))
+def _fused(
+    h, senders, receivers, num_nodes, weight, window, block_edges, interpret, fits_static
+):
     return _fused_fwd(
-        h, senders, receivers, num_nodes, weight, window, block_edges, interpret
+        h, senders, receivers, num_nodes, weight, window, block_edges, interpret,
+        fits_static,
     )[0]
 
 
-def _fused_fwd(h, senders, receivers, num_nodes, weight, window, block_edges, interpret):
+def _fused_fwd(
+    h, senders, receivers, num_nodes, weight, window, block_edges, interpret, fits_static
+):
     out, fits = _pallas_gather_scatter(
         h, senders, receivers, weight, num_nodes, window, block_edges, interpret
     )
-    ref = lambda: reference_gather_scatter(h, senders, receivers, num_nodes, weight)
-    out = jax.lax.cond(fits, lambda: out, ref).astype(h.dtype)
+    if fits_static:
+        # layout certified host-side (BatchMeta.gs_fits): kernel output is
+        # exact, no fallback in the program at all
+        out = out.astype(h.dtype)
+    else:
+        ref = lambda: reference_gather_scatter(h, senders, receivers, num_nodes, weight)
+        out = jax.lax.cond(fits, lambda: out, ref).astype(h.dtype)
     return out, (h, senders, receivers, weight)
 
 
-def _fused_bwd(num_nodes, window, block_edges, interpret, res, dout):
+def _fused_bwd(num_nodes, window, block_edges, interpret, fits_static, res, dout):
     h, senders, receivers, weight = res
     # out is linear in h: dh is the same fused op with endpoints swapped
     # (gather rows of dout by receiver, scale, scatter-add onto senders).
+    # fits_static covers this transposed call too: the fit check is per-array
+    # and role-independent, and the fwd certified BOTH senders and receivers.
     dh_out, fits = _pallas_gather_scatter(
         dout.astype(h.dtype), receivers, senders, weight, num_nodes,
         window, block_edges, interpret,
     )
-    ref = lambda: reference_gather_scatter(
-        dout.astype(h.dtype), receivers, senders, num_nodes, weight
-    )
-    dh = jax.lax.cond(fits, lambda: dh_out, ref).astype(h.dtype)
+    if fits_static:
+        dh = dh_out.astype(h.dtype)
+    else:
+        ref = lambda: reference_gather_scatter(
+            dout.astype(h.dtype), receivers, senders, num_nodes, weight
+        )
+        dh = jax.lax.cond(fits, lambda: dh_out, ref).astype(h.dtype)
     # dw[e] = <h[s_e], dout[r_e]> (summed over C for scalar weights)
     hs = jnp.take(h, senders, axis=0).astype(jnp.float32)
     dr = jnp.take(dout, receivers, axis=0).astype(jnp.float32)
@@ -261,15 +307,18 @@ def fused_gather_scatter(
     window: int = 256,
     block_edges: int = 256,
     interpret: bool | None = None,
+    fits: bool | None = None,
 ) -> Array:
     """``segment_sum(weight * h[senders], receivers, num_nodes)`` fused in one
-    Pallas kernel; falls back to the XLA path in-program when a block's node
-    window doesn't fit (correctness never depends on edge layout)."""
+    Pallas kernel. ``fits`` is the host-certified layout guarantee
+    (``BatchMeta.gs_fits``): True → kernel only, False → XLA path only,
+    None → in-program ``lax.cond`` fallback (correctness never depends on
+    edge layout, but the dynamic cond costs both branches under ``vmap``)."""
     if weight is None:
         weight = jnp.ones(senders.shape[0], dtype=h.dtype)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if not _static_ok(h, senders, num_nodes, window):
+    if fits is False or not _static_ok(h, senders, num_nodes, window):
         return reference_gather_scatter(h, senders, receivers, num_nodes, weight).astype(
             h.dtype
         )
@@ -282,7 +331,8 @@ def fused_gather_scatter(
         receivers = jnp.pad(receivers, (0, e_pad), constant_values=num_nodes - 1)
         weight = jnp.pad(weight, ((0, e_pad),) + ((0, 0),) * (weight.ndim - 1))
     return _fused(
-        h, senders, receivers, num_nodes, weight, window, block_edges, interpret
+        h, senders, receivers, num_nodes, weight, window, block_edges, interpret,
+        bool(fits),
     )
 
 
@@ -317,14 +367,18 @@ def _scatter_kernel(
     out_ref[pl.ds(r0, window), :] += partial
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
-def _fused_scatter(data, segment_ids, num_segments, window, block_edges, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _fused_scatter(
+    data, segment_ids, num_segments, window, block_edges, interpret, fits_static
+):
     return _fused_scatter_fwd(
-        data, segment_ids, num_segments, window, block_edges, interpret
+        data, segment_ids, num_segments, window, block_edges, interpret, fits_static
     )[0]
 
 
-def _fused_scatter_fwd(data, segment_ids, num_segments, window, block_edges, interpret):
+def _fused_scatter_fwd(
+    data, segment_ids, num_segments, window, block_edges, interpret, fits_static
+):
     n, c = num_segments, data.shape[1]
     e = data.shape[0]
     g = e // block_edges
@@ -344,26 +398,35 @@ def _fused_scatter_fwd(data, segment_ids, num_segments, window, block_edges, int
         out_shape=jax.ShapeDtypeStruct((n, c), jnp.float32),
         interpret=interpret,
     )(r_starts, data, r_local.reshape(g, 1, block_edges))
-    ref = lambda: jax.ops.segment_sum(
-        data.astype(jnp.float32), segment_ids, num_segments=n
-    )
-    out = jax.lax.cond(fits, lambda: out, ref).astype(data.dtype)
+    if fits_static:
+        out = out.astype(data.dtype)
+    else:
+        ref = lambda: jax.ops.segment_sum(
+            data.astype(jnp.float32), segment_ids, num_segments=n
+        )
+        out = jax.lax.cond(fits, lambda: out, ref).astype(data.dtype)
     return out, segment_ids
 
 
-def _fused_scatter_bwd(num_segments, window, block_edges, interpret, segment_ids, dout):
+def _fused_scatter_bwd(
+    num_segments, window, block_edges, interpret, fits_static, segment_ids, dout
+):
     return jnp.take(dout, segment_ids, axis=0), None
 
 
 _fused_scatter.defvjp(_fused_scatter_fwd, _fused_scatter_bwd)
 
 
-def fused_segment_sum(data: Array, segment_ids: Array, num_segments: int) -> Array:
+def fused_segment_sum(
+    data: Array, segment_ids: Array, num_segments: int, fits: bool | None = None
+) -> Array:
     """Windowed Pallas scatter-add: drop-in for ``jax.ops.segment_sum`` on 2D
     float data with (near-)sorted ids — the layout every collated batch has
-    for edge→node and node→graph reductions."""
+    for edge→node and node→graph reductions. ``fits`` as in
+    ``fused_gather_scatter`` (host-certified via ``BatchMeta``)."""
     if (
-        not _static_ok(data, segment_ids, num_segments, 128)
+        fits is False
+        or not _static_ok(data, segment_ids, num_segments, 128)
         or data.ndim != 2
         or not jnp.issubdtype(data.dtype, jnp.floating)
     ):
@@ -379,7 +442,7 @@ def fused_segment_sum(data: Array, segment_ids: Array, num_segments: int) -> Arr
             segment_ids, (0, e_pad), constant_values=num_segments - 1
         )
     return _fused_scatter(
-        data, segment_ids, num_segments, window, block_edges, interpret
+        data, segment_ids, num_segments, window, block_edges, interpret, bool(fits)
     )
 
 
@@ -390,12 +453,21 @@ def gather_scatter_sum(
     num_nodes: int,
     weight: Array | None = None,
     fused: bool | None = None,
+    hints=None,
 ) -> Array:
     """Conv-stack entry point: fused kernel when enabled (flag/env/backend
-    auto), XLA gather+``segment_sum`` otherwise."""
+    auto), XLA gather+``segment_sum`` otherwise. ``hints`` is the source
+    ``GraphBatch``: its collate-certified ``BatchMeta.gs_fits`` makes the
+    kernel-vs-fallback choice trace-time static (no cond under vmap)."""
     if fused is None:
         fused = _auto_enabled()
     if fused:
-        return fused_gather_scatter(h, senders, receivers, num_nodes, weight)
+        fits = None
+        if hints is not None and hints.meta is not None:
+            if senders is hints.senders and receivers is hints.receivers:
+                fits = hints.meta.gs_fits
+            elif senders is hints.receivers and receivers is hints.senders:
+                fits = hints.meta.gs_fits  # transposed flow: same certificate
+        return fused_gather_scatter(h, senders, receivers, num_nodes, weight, fits=fits)
     out = reference_gather_scatter(h, senders, receivers, num_nodes, weight)
     return out.astype(h.dtype)

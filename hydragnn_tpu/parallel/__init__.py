@@ -14,7 +14,6 @@ from .step import (
     shard_state,
     stack_device_batches,
     put_batch,
-    batch_shardings,
 )
 
 __all__ = [
@@ -31,7 +30,6 @@ __all__ = [
     "shard_state",
     "stack_device_batches",
     "put_batch",
-    "batch_shardings",
 ]
 from .distributed import (  # noqa: E402
     setup_ddp,

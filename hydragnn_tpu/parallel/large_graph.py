@@ -88,7 +88,10 @@ def edge_batch_shardings(mesh: Mesh, shard_nodes: bool = False) -> GraphBatch:
             return split
         return rep
 
-    return GraphBatch(*[pick(f) for f in GraphBatch._fields])
+    # meta=None matches put_large_batch, which invalidates the collate-time
+    # layout certificate (padding here changes the edge layout anyway, and
+    # the edge-sharded path always runs the XLA segment_sum)
+    return GraphBatch(*[pick(f) for f in GraphBatch._fields[:-1]], meta=None)
 
 
 def put_large_batch(
@@ -125,7 +128,10 @@ def put_large_batch(
     # node padding changes num_nodes: pad-edge endpoints must still point at
     # a PADDING node; node n_node-1 is one by the collate contract, and pads
     # added here extend the padding tail, so fills above stay valid.
-    batch = GraphBatch(*[pad_field(f, v) for f, v in zip(GraphBatch._fields, batch)])
+    batch = GraphBatch(
+        *[pad_field(f, getattr(batch, f)) for f in GraphBatch._fields[:-1]],
+        meta=None,
+    )
     sh = edge_batch_shardings(mesh, shard_nodes)
     return jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s), batch, sh)
 

@@ -35,12 +35,18 @@ from ..graphs.graph import GraphBatch
 from ..models.base import HydraModel
 from ..models.common import SYNC_BN_AXIS
 from ..train.step import TrainState, _cast_floats, donate_state_argnums as _donate
-from .mesh import DATA_AXIS, fsdp_param_specs
+from .mesh import DATA_AXIS, batch_sharding, fsdp_param_specs
 
 
 def stack_device_batches(batches: list[GraphBatch]) -> GraphBatch:
-    """Stack per-device batches into one [D, ...] GraphBatch."""
-    return GraphBatch(*[np.stack(f) for f in zip(*batches)])
+    """Stack per-device batches into one [D, ...] GraphBatch. The static
+    layout metadata merges conservatively — a fused-kernel guarantee holds
+    for the stack only if every device's batch carries it."""
+    from ..graphs.graph import BatchMeta
+
+    merged = BatchMeta.merge([b.meta for b in batches])
+    batches = [b.replace(meta=merged) for b in batches]  # align treedefs
+    return jax.tree.map(lambda *xs: np.stack(xs), *batches)
 
 
 def _spans_processes(mesh: Mesh) -> bool:
@@ -106,11 +112,6 @@ def shard_state(state: TrainState, mesh: Mesh, param_mode: str = "replicated") -
     return TrainState(params=params, batch_stats=stats, opt_state=opt_state, step=step)
 
 
-def batch_shardings(mesh: Mesh) -> GraphBatch:
-    s = NamedSharding(mesh, P(DATA_AXIS))
-    return GraphBatch(*([s] * len(GraphBatch._fields)))
-
-
 def put_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
     """Device-put a stacked batch with leading axis over data.
 
@@ -118,14 +119,13 @@ def put_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
     Multi-process: each process passes its LOCAL ``[D_local, ...]`` stack and
     the global array is assembled shard-by-shard (the jax.distributed data
     path replacing the reference's per-rank DataLoader + NCCL allreduce)."""
+    data_sh = batch_sharding(mesh)
     if _spans_processes(mesh):
-        data_sh = NamedSharding(mesh, P(DATA_AXIS))
         return jax.tree.map(
             lambda x: jax.make_array_from_process_local_data(data_sh, np.asarray(x)),
             batch,
         )
-    sh = batch_shardings(mesh)
-    return jax.tree.map(lambda x, s: jax.device_put(jnp.asarray(x), s), batch, sh)
+    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), data_sh), batch)
 
 
 def make_parallel_train_step(

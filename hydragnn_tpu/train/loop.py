@@ -45,11 +45,13 @@ def _empty_like(batch):
 
     zeroed = {"node_mask", "edge_mask", "graph_mask", "triplet_mask", "n_node",
               "graph_y", "node_y", "energy_y", "forces_y"}
-    return type(batch)(
-        *[
-            _np.zeros_like(_np.asarray(v)) if f in zeroed else _np.asarray(v)
-            for f, v in zip(batch._fields, batch)
-        ]
+    # data leaves only — the static ``meta`` certificate passes through
+    # unchanged (an all-masked clone keeps the donor batch's layout)
+    return batch.replace(
+        **{
+            f: (_np.zeros_like(_np.asarray(v)) if f in zeroed else _np.asarray(v))
+            for f, v in zip(batch._fields[:-1], batch)
+        }
     )
 
 
