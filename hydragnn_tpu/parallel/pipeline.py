@@ -26,11 +26,21 @@ Design
 * Autodiff goes straight through ``scan``+``ppermute`` — the backward pass
   is the reverse pipeline schedule, derived by AD instead of hand-scheduled.
 
-Semantics: pipelined execution is deterministic (``train=False`` through
-every conv block) — feature norms use running statistics (the standard GPipe
-BatchNorm caveat; scale/bias still train, running stats don't update), and
-conv dropout is disabled (GAT with ``dropout > 0`` is rejected up front
-rather than silently differing from the data-parallel path).
+Semantics: pipelined execution is deterministic — conv dropout is disabled
+(GAT with ``dropout > 0`` is rejected up front rather than silently
+differing from the data-parallel path). Feature-norm statistics are
+selectable via ``norm``:
+
+* ``"batch"`` (default): each conv block normalizes with the CURRENT
+  microbatch's statistics — the data-parallel train step's semantics, and
+  the only stable choice for deep stacks (a 9-layer GIN on init running
+  stats blows activations up ~degree^L, producing astronomically large but
+  "finite" losses — the round-2 dryrun's loss=7.2e7). Running stats are
+  still NOT updated (the GPipe BatchNorm caveat; a warning fires when the
+  model has feature norms — fine-tuning the checkpoint on the data-parallel
+  path later will start from init running stats).
+* ``"running"``: eval-mode running averages — bit-exact parity with the
+  sequential ``encode(train=False)`` path (what the exact-parity tests pin).
 """
 
 from __future__ import annotations
@@ -109,15 +119,22 @@ def _stack_layer_params(params: dict, stats: dict, L: int, S: int, k: int):
     return jax.tree.map(lambda x: x.reshape(S, k, *x.shape[1:]), stacked)
 
 
-def make_pipelined_forward(model: HydraModel, mesh: Mesh, n_micro: int):
+def make_pipelined_forward(
+    model: HydraModel, mesh: Mesh, n_micro: int, norm: str = "batch"
+):
     """Build ``fn(variables, microbatches) -> (inv, equiv)`` where
     ``microbatches`` is a GraphBatch stacked to ``[M, ...]`` (see
     ``parallel.stack_device_batches``) and the result carries the encoded
-    node features per microbatch ``[M, N, H]``."""
+    node features per microbatch ``[M, N, H]``. ``norm``: see module
+    docstring ("batch" = per-microbatch statistics, "running" = frozen
+    running averages)."""
     S = mesh.shape[STAGE_AXIS]
     k = validate_pipeline_support(model, S)
     L = model.spec.num_conv_layers
     M = n_micro
+    if norm not in ("batch", "running"):
+        raise ValueError(f"norm must be 'batch' or 'running', got {norm!r}")
+    use_batch_stats = norm == "batch"
 
     def forward(variables, mb: GraphBatch):
         got = jax.tree.leaves(mb)[0].shape[0]
@@ -130,10 +147,16 @@ def make_pipelined_forward(model: HydraModel, mesh: Mesh, n_micro: int):
         stats = variables.get("batch_stats", {})
 
         # prologue: embed + block 0, vmapped over microbatches (replicated)
-        inv0, equiv0 = jax.vmap(
-            lambda b: model.apply(variables, b, False,
-                                  method=HydraModel.embed_block0)
-        )(mb)
+        def prologue(b):
+            if use_batch_stats:
+                out, _ = model.apply(variables, b, True,
+                                     method=HydraModel.embed_block0,
+                                     mutable=["batch_stats"])
+                return out
+            return model.apply(variables, b, False,
+                               method=HydraModel.embed_block0)
+
+        inv0, equiv0 = jax.vmap(prologue)(mb)
 
         stacked = _stack_layer_params(params, stats, L, S, k)
 
@@ -149,6 +172,11 @@ def make_pipelined_forward(model: HydraModel, mesh: Mesh, n_micro: int):
                 if "norm_s" in p_tree:
                     sub_stats["feature_norm_1"] = p_tree["norm_s"]
                 sub_vars["batch_stats"] = sub_stats
+            if use_batch_stats:
+                out, _ = model.apply(sub_vars, 1, inv, equiv, b, True,
+                                     method=HydraModel.conv_block,
+                                     mutable=["batch_stats"])
+                return out
             return model.apply(sub_vars, 1, inv, equiv, b, False,
                                method=HydraModel.conv_block)
 
@@ -180,10 +208,15 @@ def make_pipelined_forward(model: HydraModel, mesh: Mesh, n_micro: int):
                     (inv_out, equiv_out),
                 )
                 # only the last stage's result is the stack output; psum
-                # broadcasts it (other stages contribute zeros)
-                is_last = (sidx == S - 1).astype(inv_out.dtype)
-                y = jax.lax.psum((inv_out * is_last, equiv_out * is_last),
-                                 STAGE_AXIS)
+                # broadcasts it. where-select (not multiply-mask) so a
+                # non-finite value from a bubble-tick zero carry can never
+                # leak through as 0*inf=NaN
+                is_last = sidx == S - 1
+                y = jax.lax.psum(
+                    (jnp.where(is_last, inv_out, 0),
+                     jnp.where(is_last, equiv_out, 0)),
+                    STAGE_AXIS,
+                )
                 return send, y
 
             zero = (jnp.zeros_like(inv0[0]), jnp.zeros_like(equiv0[0]))
@@ -207,12 +240,24 @@ def make_pipelined_forward(model: HydraModel, mesh: Mesh, n_micro: int):
 
 def make_pipelined_train_step(
     model: HydraModel, optimizer, mesh: Mesh, n_micro: int,
-    compute_dtype=jnp.float32,
+    compute_dtype=jnp.float32, norm: str = "batch",
 ):
     """Jitted pipelined train step: (state, microbatches[M, ...]) ->
     (state, metrics). Loss is the graph-weighted mean over microbatches,
     the same bookkeeping as the data-parallel step."""
-    encode = make_pipelined_forward(model, mesh, n_micro)
+    conv_cls = CONV_REGISTRY[model.spec.mpnn_type]
+    if getattr(conv_cls, "feature_norm", True):
+        import warnings
+
+        warnings.warn(
+            "pipelined training never updates feature-norm RUNNING stats "
+            "(scale/bias still train; blocks normalize with "
+            f"{'per-microbatch' if norm == 'batch' else 'init running'} "
+            "statistics). A checkpoint fine-tuned or evaluated later on the "
+            "data-parallel path will start from init running stats.",
+            stacklevel=2,
+        )
+    encode = make_pipelined_forward(model, mesh, n_micro, norm=norm)
 
     def loss_fn(params, batch_stats, mb: GraphBatch):
         c_params = _cast_floats(params, compute_dtype)
@@ -254,6 +299,47 @@ def make_pipelined_train_step(
         return new_state, {"loss": loss, "tasks_loss": tasks, "num_graphs": ng}
 
     return train_step
+
+
+def make_pipelined_eval_step(
+    model: HydraModel, mesh: Mesh, n_micro: int,
+    compute_dtype=jnp.float32, norm: str = "batch",
+):
+    """Pipelined evaluation: same metrics dict as the data-parallel eval step
+    (loss, per-task losses, per-head sse/count, graph count) so the epoch
+    loop consumes either interchangeably. ``norm`` defaults to "batch" to
+    match what pipelined TRAINING optimized (running stats never update
+    under pipelining, so eval-mode running averages would be init values)."""
+    encode = make_pipelined_forward(model, mesh, n_micro, norm=norm)
+
+    @jax.jit
+    def eval_step(state: TrainState, mb: GraphBatch):
+        c_params = _cast_floats(state.params, compute_dtype)
+        c_mb = _cast_floats(mb, compute_dtype)
+        variables = {"params": c_params, "batch_stats": state.batch_stats}
+        inv, equiv = encode(variables, c_mb)
+
+        def per_micro(inv_m, equiv_m, b, b_raw):
+            pred = model.apply(variables, inv_m, equiv_m, b, False,
+                               method=HydraModel.decode)
+            pred = _cast_floats(pred, jnp.float32)
+            tot, tasks = model.loss(pred, b_raw)
+            sses, counts = model.head_sse(pred, b_raw)
+            ng = b_raw.graph_mask.sum()
+            return (tot * ng, jnp.stack(tasks) * ng, jnp.stack(sses),
+                    jnp.stack(counts), ng)
+
+        tots, tasks, sses, counts, ngs = jax.vmap(per_micro)(inv, equiv, c_mb, mb)
+        denom = jnp.maximum(ngs.sum(), 1.0)
+        return {
+            "loss": tots.sum() / denom,
+            "tasks_loss": tasks.sum(axis=0) / denom,
+            "head_sse": sses.sum(axis=0),
+            "head_count": counts.sum(axis=0),
+            "num_graphs": ngs.sum(),
+        }
+
+    return eval_step
 
 
 def put_microbatches(mb: GraphBatch, mesh: Mesh) -> GraphBatch:

@@ -117,6 +117,17 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             raise ValueError(
                 f"HYDRAGNN_FSDP_STRATEGY={_fsdp_strategy!r} not one of {sorted(_known)}"
             )
+    # Architecture.parallelism routes the mesh layout (mirrors how
+    # edge_sharding routes the long-context path): "data" (default),
+    # "tensor" (feature-axis TP over an inner model axis), or
+    # "pipeline" (GPipe conv-stack pipelining over a stage ring).
+    arch_cfg = config["NeuralNetwork"].get("Architecture", {})
+    par_mode = str(arch_cfg.get("parallelism") or "data").lower()
+    if par_mode not in ("data", "tensor", "pipeline"):
+        raise ValueError(
+            f"Architecture.parallelism {par_mode!r} not one of "
+            "'data', 'tensor', 'pipeline'"
+        )
     mesh = None
     try:
         import jax
@@ -125,9 +136,7 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
         n_local = len(jax.local_devices())
         # edge-sharded (long-context) mode feeds ONE batch to the whole mesh,
         # so any loader length works
-        edge_mode = bool(
-            config["NeuralNetwork"].get("Architecture", {}).get("edge_sharding")
-        )
+        edge_mode = bool(arch_cfg.get("edge_sharding"))
         if (
             flags.get(flags.AUTO_PARALLEL)
             and n_dev > 1
@@ -135,22 +144,67 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
         ):
             from .parallel import make_mesh, shard_state
 
-            mesh = make_mesh()
-            # FSDP_STRATEGY maps the reference's torch strategies
-            # (distributed.py:435-437): NO_SHARD -> replicated, everything
-            # else -> param+opt sharding over the data axis
-            param_mode = (
-                "fsdp" if _fsdp_requested and _fsdp_strategy != "NO_SHARD"
-                else "replicated"
-            )
-            state = shard_state(state, mesh, param_mode=param_mode)
+            if par_mode == "pipeline":
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from .parallel.pipeline import (
+                    make_pipeline_mesh,
+                    validate_pipeline_support,
+                )
+
+                validate_pipeline_support(model, n_dev)  # explicit: fail fast
+                mesh = make_pipeline_mesh(n_dev)
+                rep = NamedSharding(mesh, P())
+                state = jax.tree.map(
+                    lambda x: jax.device_put(x, rep)
+                    if hasattr(x, "shape") else x,
+                    state,
+                )
+                print_distributed(
+                    verbosity, f"pipeline-parallel: {n_dev}-stage GPipe ring"
+                )
+            elif par_mode == "tensor":
+                tp = int(
+                    arch_cfg.get("tensor_parallel_size")
+                    or (4 if n_dev % 4 == 0 else 2)
+                )
+                if n_dev % tp:
+                    raise ValueError(
+                        f"tensor_parallel_size={tp} does not divide the "
+                        f"{n_dev}-device mesh"
+                    )
+                mesh = make_mesh(n_data=n_dev // tp, n_model=tp)
+                state = shard_state(state, mesh, param_mode="tp")
+                print_distributed(
+                    verbosity,
+                    f"tensor-parallel: ({n_dev // tp} data x {tp} model) mesh",
+                )
+            else:
+                mesh = make_mesh()
+                # FSDP_STRATEGY maps the reference's torch strategies
+                # (distributed.py:435-437): NO_SHARD -> replicated,
+                # everything else -> param+opt sharding over the data axis
+                param_mode = (
+                    "fsdp" if _fsdp_requested and _fsdp_strategy != "NO_SHARD"
+                    else "replicated"
+                )
+                state = shard_state(state, mesh, param_mode=param_mode)
+                print_distributed(
+                    verbosity,
+                    f"auto-parallel: {n_dev}-device data mesh ({param_mode})",
+                )
             # publish the mesh for trace-time consumers (ring attention)
             from .parallel.ring_attention import set_global_mesh
 
-            set_global_mesh(mesh)
-            print_distributed(verbosity, f"auto-parallel: {n_dev}-device data mesh ({param_mode})")
+            if par_mode != "pipeline":
+                set_global_mesh(mesh)
+        elif par_mode != "data":
+            raise ValueError(
+                f"Architecture.parallelism={par_mode!r} requested but no "
+                f"multi-device mesh is available ({n_dev} device(s), "
+                f"{len(train_loader)} train batches)"
+            )
     except Exception as e:
-        if flags.get(flags.USE_FSDP):
+        if flags.get(flags.USE_FSDP) or par_mode != "data":
             raise  # explicit sharding request: fail fast, don't downgrade
         print_distributed(verbosity, f"auto-parallel disabled ({e})")
         mesh = None

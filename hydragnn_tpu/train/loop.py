@@ -228,6 +228,26 @@ def train_validate_test(
         )
         eval_step = make_edge_sharded_eval_step(model, mesh, compute_dtype=precision)
         put_fn = _partial(put_large_batch, mesh=mesh, shard_nodes=shard_nodes)
+    elif mesh is not None and mesh.axis_names == ("stage",):
+        # GPipe pipeline mesh (Architecture.parallelism: "pipeline"): each
+        # step consumes n_micro stacked microbatches through the stage ring
+        from ..parallel.pipeline import (
+            STAGE_AXIS,
+            make_pipelined_eval_step,
+            make_pipelined_train_step,
+            put_microbatches,
+        )
+
+        n_micro = int(
+            config_nn.get("Architecture", {}).get("pipeline_microbatches")
+            or mesh.shape[STAGE_AXIS]
+        )
+        train_step = make_pipelined_train_step(
+            model, optimizer, mesh, n_micro=n_micro, compute_dtype=precision
+        )
+        eval_step = make_pipelined_eval_step(
+            model, mesh, n_micro=n_micro, compute_dtype=precision
+        )
     elif mesh is not None:
         from ..parallel.step import make_parallel_eval_step, make_parallel_train_step
 

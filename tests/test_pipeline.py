@@ -1,5 +1,8 @@
 """Pipeline parallelism: GPipe microbatch schedule over a stage mesh must
-reproduce sequential execution exactly (same train=False semantics)."""
+reproduce sequential execution exactly — in ``norm="running"`` mode against
+``encode(train=False)`` (bit-exact eval semantics) and in the default
+``norm="batch"`` mode against ``encode(train=True)`` with stat updates
+dropped (per-microbatch statistics, the data-parallel train semantics)."""
 
 import copy
 
@@ -71,7 +74,7 @@ def test_pipeline_rejects_gat_dropout_and_bad_micro_count():
     model, batches = setup(num_conv_layers=5, n_micro=4)
     mesh = make_pipeline_mesh(4)
     variables = init_model(model, batches[0])
-    fwd = make_pipelined_forward(model, mesh, n_micro=4)
+    fwd = make_pipelined_forward(model, mesh, n_micro=4, norm="running")
     with pytest.raises(ValueError, match="leading dim"):
         fwd(variables, put_microbatches(stack_device_batches(batches[:3]), mesh))
 
@@ -82,13 +85,40 @@ def test_pipelined_forward_matches_sequential():
     variables = init_model(model, batches[0])
     mb = put_microbatches(stack_device_batches(batches), mesh)
 
-    fwd = make_pipelined_forward(model, mesh, n_micro=4)
+    fwd = make_pipelined_forward(model, mesh, n_micro=4, norm="running")
     inv_p, equiv_p = jax.jit(fwd)(variables, mb)
 
     for m, b in enumerate(batches):
         b = jax.tree.map(jnp.asarray, b)
         inv_s, equiv_s = model.apply(variables, b, False,
                                      method=type(model).encode)
+        np.testing.assert_allclose(
+            np.asarray(inv_p[m]), np.asarray(inv_s), rtol=2e-5, atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(equiv_p[m]), np.asarray(equiv_s), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_pipelined_batch_norm_mode_matches_sequential_train_stats():
+    """Default norm='batch': per-microbatch statistics must reproduce a
+    sequential encode(train=True) pass (stat updates discarded) — the
+    data-parallel path's normalization semantics, and the fix for the deep-
+    stack activation blowup (round-2 dryrun pp loss=7.2e7)."""
+    model, batches = setup(num_conv_layers=5, n_micro=4)
+    mesh = make_pipeline_mesh(4)
+    variables = init_model(model, batches[0])
+    mb = put_microbatches(stack_device_batches(batches), mesh)
+
+    fwd = make_pipelined_forward(model, mesh, n_micro=4)  # norm="batch"
+    inv_p, equiv_p = jax.jit(fwd)(variables, mb)
+
+    for m, b in enumerate(batches):
+        b = jax.tree.map(jnp.asarray, b)
+        (inv_s, equiv_s), _ = model.apply(
+            variables, b, True, method=type(model).encode,
+            mutable=["batch_stats"],
+        )
         np.testing.assert_allclose(
             np.asarray(inv_p[m]), np.asarray(inv_s), rtol=2e-5, atol=2e-5
         )
@@ -120,7 +150,7 @@ def test_pipelined_two_stage_deeper_per_stage():
     mesh = make_pipeline_mesh(2)
     variables = init_model(model, batches[0])
     mb = put_microbatches(stack_device_batches(batches[:3]), mesh)
-    fwd = make_pipelined_forward(model, mesh, n_micro=3)
+    fwd = make_pipelined_forward(model, mesh, n_micro=3, norm="running")
     inv_p, _ = jax.jit(fwd)(variables, mb)
     b0 = jax.tree.map(jnp.asarray, batches[0])
     inv_s, _ = model.apply(variables, b0, False, method=type(model).encode)
