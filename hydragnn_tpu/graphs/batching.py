@@ -38,9 +38,16 @@ class PadSpec:
 
     ``node_cap``: dataset-wide upper bound on PER-GRAPH node count (0 =
     unknown). Collate certifies each batch against it so GPS can choose
-    dense-block vs flat attention at trace time (``BatchMeta.max_n_node``)."""
+    dense-block vs flat attention at trace time (``BatchMeta.max_n_node``).
 
-    __slots__ = ("n_node", "n_edge", "n_graph", "n_triplet", "node_cap")
+    ``attn_cap``: the model's dense-attention width (GPS ``max_graph_nodes``)
+    when the USER capped it below the dataset max (0 = not capped). Collate
+    then certifies fitting batches at ``attn_cap`` instead of the bigger
+    ``node_cap``, so typical batches still take the dense-block path — only
+    genuine outliers certify a larger power-of-two bound and go flat."""
+
+    __slots__ = ("n_node", "n_edge", "n_graph", "n_triplet", "node_cap",
+                 "attn_cap")
 
     def __init__(
         self,
@@ -49,12 +56,14 @@ class PadSpec:
         n_graph: int,
         n_triplet: int = 0,
         node_cap: int = 0,
+        attn_cap: int = 0,
     ):
         self.n_node = int(n_node)
         self.n_edge = int(n_edge)
         self.n_graph = int(n_graph)
         self.n_triplet = int(n_triplet)
         self.node_cap = int(node_cap)
+        self.attn_cap = int(attn_cap)
 
     def as_tuple(self) -> tuple[int, int, int, int]:
         return (self.n_node, self.n_edge, self.n_graph, self.n_triplet)
@@ -78,6 +87,7 @@ def compute_pad_spec(
     node_multiple: int = 8,
     edge_multiple: int = 128,
     slack: float = 1.0,
+    attn_cap: int = 0,
 ) -> PadSpec:
     """Derive a bucket that fits any ``batch_size`` samples drawn from
     ``samples``. Uses max-per-sample × batch_size (safe upper bound) rounded to
@@ -97,7 +107,7 @@ def compute_pad_spec(
     )
     return PadSpec(
         n_node=n_node, n_edge=n_edge, n_graph=batch_size + 1, n_triplet=n_triplet,
-        node_cap=int(max_nodes),
+        node_cap=int(max_nodes), attn_cap=int(attn_cap),
     )
 
 
@@ -211,7 +221,8 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
         n_node=n_node, dataset_id=dataset_id,
         idx_kj=idx_kj, idx_ji=idx_ji, triplet_mask=triplet_mask,
         pe=pe, rel_pe=rel_pe, z=z,
-        meta=_batch_meta(senders, receivers, batch, n_node, N, G, pad.node_cap),
+        meta=_batch_meta(senders, receivers, batch, n_node, N, G, pad.node_cap,
+                         getattr(pad, "attn_cap", 0)),
     )
 
 
@@ -223,24 +234,38 @@ def _batch_meta(
     N: int,
     G: int,
     node_cap: int,
+    attn_cap: int = 0,
 ) -> BatchMeta:
     """Certify the fused-kernel layout contracts for this batch host-side, so
     every kernel-vs-fallback choice downstream is trace-time static (see
     ``BatchMeta``). ``max_n_node`` is the bucket's dataset-wide ``node_cap``
     whenever this batch honors it (the stable common case — one treedef for
     the whole run); an outlier batch gets its own power-of-two bound, keeping
-    the number of distinct treedefs (→ retraces) at O(log N)."""
-    from ..ops.fused_scatter import segment_window, window_fits_host
+    the number of distinct treedefs (→ retraces) at O(log N). A USER-capped
+    dense-attention width below ``node_cap`` (``attn_cap``) adds one more
+    stable certification level, so batches of small graphs keep GPS's
+    dense-block path instead of all going flat (round-3 advisor finding)."""
+    from ..ops.fused_scatter import (
+        GS_CERT_BLOCK,
+        GS_CERT_WINDOW,
+        segment_window,
+        window_fits_host,
+    )
 
     largest = int(n_node.max()) if n_node.size else 0
-    if node_cap and largest <= node_cap:
+    pow2 = max(1 << max(largest - 1, 0).bit_length(), 8)
+    if attn_cap and 0 < attn_cap < node_cap:
+        # user capped dense attention below the dataset max: certify fitting
+        # batches at the cap (one stable treedef), outliers at their pow2
+        bound = attn_cap if largest <= attn_cap else pow2
+    elif node_cap and largest <= node_cap:
         bound = node_cap
     else:
-        bound = max(1 << max(largest - 1, 0).bit_length(), 8)
+        bound = pow2
     return BatchMeta(
         gs_fits=(
-            window_fits_host(senders, N, 256, 256)
-            and window_fits_host(receivers, N, 256, 256)
+            window_fits_host(senders, N, GS_CERT_WINDOW, GS_CERT_BLOCK)
+            and window_fits_host(receivers, N, GS_CERT_WINDOW, GS_CERT_BLOCK)
         ),
         recv_fits=window_fits_host(receivers, N, segment_window(N), 256),
         send_fits=window_fits_host(senders, N, segment_window(N), 256),
@@ -258,6 +283,7 @@ def compute_pad_buckets(
     quantiles: Sequence[float] = (0.5, 0.8, 0.95),
     n_sim: int = 512,
     seed: int = 0,
+    attn_cap: int = 0,
 ) -> list[PadSpec]:
     """Derive up to ``max_buckets`` padding buckets from the batch-total size
     distribution (SURVEY §7 step 1: bucketed padding with a bounded compile
@@ -265,7 +291,8 @@ def compute_pad_buckets(
     top bucket is the same worst-case bound ``compute_pad_spec`` gives, so any
     batch always fits. Mixed-size datasets (the GFM case) collate most batches
     to a much tighter bucket instead of the dataset-wide worst case."""
-    worst = compute_pad_spec(samples, batch_size, node_multiple, edge_multiple)
+    worst = compute_pad_spec(samples, batch_size, node_multiple, edge_multiple,
+                             attn_cap=attn_cap)
     if len(samples) <= batch_size or max_buckets <= 1:
         return [worst]
     sizes = np.array(
@@ -294,6 +321,7 @@ def compute_pad_buckets(
             if worst.n_triplet
             else 0,
             node_cap=worst.node_cap,
+            attn_cap=worst.attn_cap,
         )
         if spec not in buckets and spec != worst:
             buckets.append(spec)

@@ -113,6 +113,9 @@ def radius_graph(
     pbc: np.ndarray | None = None,
     max_neighbours: int | None = None,
     loop: bool = False,
+    ensure_connected: bool = False,
+    cutoff_multiplier: float = 1.25,
+    max_attempts: int = 3,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Build a directed radius graph.
 
@@ -120,6 +123,16 @@ def radius_graph(
     already in Cartesian coordinates (``integer_shift @ cell``), i.e. what
     ``GraphBatch.edge_shifts`` stores. Convention: edge (s, r) carries the
     message s -> r and geometric vector ``pos[r] - pos[s] + shift``.
+
+    ``ensure_connected`` (off here — the SAMPLE-ingestion wrapper
+    ``build_radius_graph`` turns it on) guarantees every node at least one
+    incoming edge, mirroring the reference's adaptive-cutoff loop
+    (``graph_samples_checks_and_updates.py:170-227``): when any node ends up
+    edgeless after pruning, the cutoff grows by ``cutoff_multiplier`` (up to
+    ``max_attempts`` tries); nodes still isolated after the final attempt are
+    force-connected (``:300-322``) — here to their NEAREST other atom
+    (deterministic, unlike the reference's random pick, so every process of a
+    multi-host run builds the same graph) with a zero shift vector.
     """
     pos = np.asarray(pos, dtype=np.float64)
     n = pos.shape[0]
@@ -127,6 +140,44 @@ def radius_graph(
         z = np.zeros((0,), np.int32)
         return z, z, np.zeros((0, 3), np.float32)
 
+    cutoff = float(radius)
+    attempts = max(1, int(max_attempts)) if ensure_connected else 1
+    for attempt in range(attempts):
+        senders, receivers, shifts = _build_once(
+            pos, cutoff, cell, pbc, max_neighbours, loop
+        )
+        if not ensure_connected:
+            break
+        covered = np.zeros(n, dtype=bool)
+        covered[receivers] = True
+        if covered.all():
+            break
+        if attempt < attempts - 1:
+            cutoff *= cutoff_multiplier
+        else:
+            senders, receivers, shifts = _force_connect(
+                pos, np.flatnonzero(~covered), senders, receivers, shifts,
+                cutoff, cell, pbc,
+            )
+    # Receiver-sorted edge order: segment reductions see contiguous runs per
+    # node, which keeps the Pallas fused-scatter kernel's per-block node
+    # windows narrow (ops/fused_scatter.py). Semantics are order-invariant.
+    order = np.lexsort((senders, receivers))
+    senders, receivers, shifts = senders[order], receivers[order], shifts[order]
+    return senders.astype(np.int32), receivers.astype(np.int32), shifts.astype(np.float32)
+
+
+def _build_once(
+    pos: np.ndarray,
+    radius: float,
+    cell: np.ndarray | None,
+    pbc: np.ndarray | None,
+    max_neighbours: int | None,
+    loop: bool,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One neighbor-search pass at a fixed cutoff (incl. max-neighbor
+    pruning — connectivity is judged on the PRUNED edge set, like the
+    reference's loop)."""
     if cell is None or pbc is None or not np.any(pbc):
         senders, receivers = _pairs_within(pos, pos, radius)
         if not loop:
@@ -142,12 +193,56 @@ def radius_graph(
         senders, receivers, shifts = _prune_max_neighbours(
             pos, senders, receivers, shifts, max_neighbours
         )
-    # Receiver-sorted edge order: segment reductions see contiguous runs per
-    # node, which keeps the Pallas fused-scatter kernel's per-block node
-    # windows narrow (ops/fused_scatter.py). Semantics are order-invariant.
-    order = np.lexsort((senders, receivers))
-    senders, receivers, shifts = senders[order], receivers[order], shifts[order]
-    return senders.astype(np.int32), receivers.astype(np.int32), shifts.astype(np.float32)
+    return senders, receivers, shifts
+
+
+def _force_connect(
+    pos: np.ndarray,
+    missing: np.ndarray,
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    shifts: np.ndarray,
+    cutoff: float,
+    cell: np.ndarray | None,
+    pbc: np.ndarray | None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Give each still-isolated node one incoming edge from its nearest other
+    atom (minimum-image distance under PBC). The edge's shift vector is
+    chosen so the geometric edge VECTOR has length exactly ``cutoff`` — the
+    reference records the artificial edge at ``cutoff - 1e-8``
+    (``graph_samples_checks_and_updates.py:318``) for the same reason: a
+    physically honest 50 Å edge would poison dataset-global edge-length
+    normalization and fall outside every radial basis. A single-atom graph
+    degenerates to a self-edge, as in the reference."""
+    n = pos.shape[0]
+    m = missing.shape[0]
+    if n == 1:
+        new_s = np.zeros(m, np.int64)
+        new_shifts = np.zeros((m, 3))
+    else:
+        # displacement FROM each candidate source TO the missing node
+        disp = pos[missing][:, None, :] - pos[None, :, :]  # [m, n, 3] = r - s
+        if cell is not None and pbc is not None and np.any(pbc):
+            c = np.asarray(cell, np.float64).reshape(3, 3)
+            frac = disp @ np.linalg.inv(c)
+            frac -= np.round(frac) * np.asarray(pbc, bool).reshape(3)
+            disp = frac @ c  # minimum-image displacement
+        d2 = np.sum(disp * disp, axis=-1)
+        d2[np.arange(m), missing] = np.inf
+        new_s = np.argmin(d2, axis=1)
+        vec = disp[np.arange(m), new_s]  # min-image vector s -> r
+        dist = np.linalg.norm(vec, axis=1, keepdims=True)
+        dist = np.where(dist > 0, dist, 1.0)
+        # scale the edge vector down to cutoff length; the shift absorbs the
+        # difference so pos[r] - pos[s] + shift == vec_clamped
+        vec_clamped = np.where(
+            dist > cutoff, vec / dist * (cutoff * (1 - 1e-8)), vec
+        )
+        new_shifts = vec_clamped - (pos[missing] - pos[new_s])
+    senders = np.concatenate([senders, new_s.astype(senders.dtype)])
+    receivers = np.concatenate([receivers, missing.astype(receivers.dtype)])
+    shifts = np.concatenate([shifts, new_shifts.astype(shifts.dtype)])
+    return senders, receivers, shifts
 
 
 def _radius_graph_pbc(
@@ -209,6 +304,7 @@ def build_radius_graph(
     radius: float,
     max_neighbours: int | None = None,
     loop: bool = False,
+    ensure_connected: bool = True,
 ) -> GraphSample:
     """Attach a radius graph (with PBC if ``sample.cell``/``sample.pbc`` set)
     to a ``GraphSample`` in place; returns the sample for chaining."""
@@ -219,6 +315,7 @@ def build_radius_graph(
         pbc=sample.pbc,
         max_neighbours=max_neighbours,
         loop=loop,
+        ensure_connected=ensure_connected,
     )
     sample.senders = s
     sample.receivers = r

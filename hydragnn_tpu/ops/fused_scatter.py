@@ -53,6 +53,12 @@ Array = jax.Array
 # statically falls back to the XLA path rather than risk a VMEM OOM.
 _VMEM_RESIDENT_LIMIT = 10 * 1024 * 1024
 
+# The (window, block_edges) geometry collate's host-side layout certificate
+# (BatchMeta.gs_fits) is checked against; a certificate is only honored for
+# exactly this geometry.
+GS_CERT_WINDOW = 256
+GS_CERT_BLOCK = 256
+
 
 def _flag_enabled() -> bool | None:
     from ..utils import flags
@@ -313,7 +319,15 @@ def fused_gather_scatter(
     Pallas kernel. ``fits`` is the host-certified layout guarantee
     (``BatchMeta.gs_fits``): True → kernel only, False → XLA path only,
     None → in-program ``lax.cond`` fallback (correctness never depends on
-    edge layout, but the dynamic cond costs both branches under ``vmap``)."""
+    edge layout, but the dynamic cond costs both branches under ``vmap``).
+
+    A ``fits`` certificate is only sound for the (window, block_edges) it was
+    checked against — collate certifies the defaults
+    (``GS_CERT_WINDOW``/``GS_CERT_BLOCK``); any other geometry drops the
+    certificate and re-enters the dynamic in-program check rather than
+    silently trusting an uncertified layout."""
+    if (window, block_edges) != (GS_CERT_WINDOW, GS_CERT_BLOCK):
+        fits = None
     if weight is None:
         weight = jnp.ones(senders.shape[0], dtype=h.dtype)
     if interpret is None:

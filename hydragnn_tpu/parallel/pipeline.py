@@ -35,10 +35,11 @@ selectable via ``norm``:
   microbatch's statistics — the data-parallel train step's semantics, and
   the only stable choice for deep stacks (a 9-layer GIN on init running
   stats blows activations up ~degree^L, producing astronomically large but
-  "finite" losses — the round-2 dryrun's loss=7.2e7). Running stats are
-  still NOT updated (the GPipe BatchNorm caveat; a warning fires when the
-  model has feature norms — fine-tuning the checkpoint on the data-parallel
-  path later will start from init running stats).
+  "finite" losses — the round-2 dryrun's loss=7.2e7). The TRAIN step also
+  accumulates running stats (one EMA step per microbatch, averaged — the
+  data-parallel step's replica-mean semantics), so a pipelined checkpoint
+  later evaluates/fine-tunes on the data-parallel path from real statistics
+  rather than init values.
 * ``"running"``: eval-mode running averages — bit-exact parity with the
   sequential ``encode(train=False)`` path (what the exact-parity tests pin).
 """
@@ -120,20 +121,32 @@ def _stack_layer_params(params: dict, stats: dict, L: int, S: int, k: int):
 
 
 def make_pipelined_forward(
-    model: HydraModel, mesh: Mesh, n_micro: int, norm: str = "batch"
+    model: HydraModel, mesh: Mesh, n_micro: int, norm: str = "batch",
+    collect_stats: bool = False,
 ):
     """Build ``fn(variables, microbatches) -> (inv, equiv)`` where
     ``microbatches`` is a GraphBatch stacked to ``[M, ...]`` (see
     ``parallel.stack_device_batches``) and the result carries the encoded
     node features per microbatch ``[M, N, H]``. ``norm``: see module
     docstring ("batch" = per-microbatch statistics, "running" = frozen
-    running averages)."""
+    running averages).
+
+    ``collect_stats=True`` (requires ``norm="batch"``) returns
+    ``(inv, equiv, new_batch_stats)``: each feature norm's running stats
+    after one EMA step per microbatch (from the same old stats), averaged
+    over microbatches — identical semantics to the data-parallel step's
+    replica-mean stat update. Prologue stats come off the vmapped block-0
+    pass; blocks 1..L-1 accumulate valid-tick stats on each stage and leave
+    the ring stacked ``[L-1, ...]`` over the stage axis."""
     S = mesh.shape[STAGE_AXIS]
     k = validate_pipeline_support(model, S)
     L = model.spec.num_conv_layers
     M = n_micro
     if norm not in ("batch", "running"):
         raise ValueError(f"norm must be 'batch' or 'running', got {norm!r}")
+    if collect_stats and norm != "batch":
+        raise ValueError("collect_stats requires norm='batch' (running-stat "
+                         "EMA steps are computed from per-microbatch stats)")
     use_batch_stats = norm == "batch"
 
     def forward(variables, mb: GraphBatch):
@@ -145,24 +158,27 @@ def make_pipelined_forward(
             )
         params = variables["params"]
         stats = variables.get("batch_stats", {})
+        collect_ring = collect_stats and "feature_norm_1" in stats
 
         # prologue: embed + block 0, vmapped over microbatches (replicated)
         def prologue(b):
             if use_batch_stats:
-                out, _ = model.apply(variables, b, True,
-                                     method=HydraModel.embed_block0,
-                                     mutable=["batch_stats"])
-                return out
+                out, upd = model.apply(variables, b, True,
+                                       method=HydraModel.embed_block0,
+                                       mutable=["batch_stats"])
+                return out, upd.get("batch_stats", {})
             return model.apply(variables, b, False,
-                               method=HydraModel.embed_block0)
+                               method=HydraModel.embed_block0), {}
 
-        inv0, equiv0 = jax.vmap(prologue)(mb)
+        (inv0, equiv0), pro_upd = jax.vmap(prologue)(mb)
 
         stacked = _stack_layer_params(params, stats, L, S, k)
 
         def apply_block(p_tree, inv, equiv, b):
             """Re-apply the model's conv_block(1) with this layer's params
-            substituted — the scanned pipeline body."""
+            substituted — the scanned pipeline body. Returns the block
+            output and (when normalizing by batch stats) the layer's
+            EMA-stepped ``feature_norm_1`` stats subtree."""
             sub_params = dict(params, **{"graph_convs_1": p_tree["conv"]})
             sub_vars = {"params": sub_params}
             if "norm_p" in p_tree:
@@ -173,21 +189,25 @@ def make_pipelined_forward(
                     sub_stats["feature_norm_1"] = p_tree["norm_s"]
                 sub_vars["batch_stats"] = sub_stats
             if use_batch_stats:
-                out, _ = model.apply(sub_vars, 1, inv, equiv, b, True,
-                                     method=HydraModel.conv_block,
-                                     mutable=["batch_stats"])
-                return out
+                out, upd = model.apply(sub_vars, 1, inv, equiv, b, True,
+                                       method=HydraModel.conv_block,
+                                       mutable=["batch_stats"])
+                return out, upd.get("batch_stats", {}).get("feature_norm_1", {})
             return model.apply(sub_vars, 1, inv, equiv, b, False,
-                               method=HydraModel.conv_block)
+                               method=HydraModel.conv_block), {}
 
         def stage_fn(my_params, inv0, equiv0, mb):
             my_params = jax.tree.map(lambda x: x[0], my_params)  # [k, ...]
             sidx = jax.lax.axis_index(STAGE_AXIS)
             T = M + S - 1
             perm = [(i, (i + 1) % S) for i in range(S)]
+            acc0 = (
+                jax.tree.map(jnp.zeros_like, my_params["norm_s"])
+                if collect_ring else None
+            )
 
             def tick(carry, t):
-                inv_c, equiv_c = carry
+                inv_c, equiv_c, acc = carry
                 m = jnp.clip(t - sidx, 0, M - 1)
                 b = jax.tree.map(
                     lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, False), mb
@@ -198,11 +218,20 @@ def make_pipelined_forward(
                 equiv_in = jnp.where(sidx == 0, fresh_equiv, equiv_c)
 
                 def lay(c, p):
-                    return apply_block(p, c[0], c[1], b), None
+                    out, upd = apply_block(p, c[0], c[1], b)
+                    return out, upd
 
-                (inv_out, equiv_out), _ = jax.lax.scan(
+                (inv_out, equiv_out), upds = jax.lax.scan(
                     lay, (inv_in, equiv_in), my_params
                 )
+                if acc is not None:
+                    # bubble ticks recompute a clipped microbatch on a junk
+                    # ring carry — where-select (not multiply) keeps any
+                    # non-finite garbage out of the accumulator
+                    valid = (t >= sidx) & (t - sidx < M)
+                    acc = jax.tree.map(
+                        lambda a, u: a + jnp.where(valid, u, 0), acc, upds
+                    )
                 send = jax.tree.map(
                     lambda a: jax.lax.ppermute(a, STAGE_AXIS, perm),
                     (inv_out, equiv_out),
@@ -217,23 +246,46 @@ def make_pipelined_forward(
                      jnp.where(is_last, equiv_out, 0)),
                     STAGE_AXIS,
                 )
-                return send, y
+                return (send[0], send[1], acc), y
 
-            zero = (jnp.zeros_like(inv0[0]), jnp.zeros_like(equiv0[0]))
-            _, ys = jax.lax.scan(tick, zero, jnp.arange(T))
+            zero = (jnp.zeros_like(inv0[0]), jnp.zeros_like(equiv0[0]), acc0)
+            (_, _, acc), ys = jax.lax.scan(tick, zero, jnp.arange(T))
             # microbatch m completes at tick m + S - 1
-            return jax.tree.map(lambda a: a[S - 1 : S - 1 + M], ys)
+            out = jax.tree.map(lambda a: a[S - 1 : S - 1 + M], ys)
+            if collect_ring:
+                # each stage saw each of its microbatches once -> mean
+                return out, jax.tree.map(lambda a: a / M, acc)
+            return out
 
         from jax.experimental.shard_map import shard_map
 
-        inv, equiv = shard_map(
+        out = shard_map(
             stage_fn,
             mesh=mesh,
             in_specs=(P(STAGE_AXIS), P(), P(), P()),
-            out_specs=P(),
+            out_specs=((P(), P()), P(STAGE_AXIS)) if collect_ring else P(),
             check_rep=False,
         )(stacked, inv0, equiv0, mb)
-        return inv, equiv
+        ring = None
+        if collect_ring:
+            (inv, equiv), ring = out
+        else:
+            inv, equiv = out
+        if not collect_stats:
+            return inv, equiv
+        # assemble the updated batch_stats pytree: prologue norms from the
+        # vmapped pass (mean over microbatches), ring norms unstacked from
+        # the [L-1, ...] stage-axis output
+        new_stats = dict(stats)
+        new_stats.update(
+            jax.tree.map(lambda x: x.mean(axis=0), pro_upd)
+        )
+        if collect_ring:
+            for i in range(1, L):
+                key = f"feature_norm_{i}"
+                if key in stats:
+                    new_stats[key] = jax.tree.map(lambda x: x[i - 1], ring)
+        return inv, equiv, jax.lax.stop_gradient(new_stats)
 
     return forward
 
@@ -244,26 +296,33 @@ def make_pipelined_train_step(
 ):
     """Jitted pipelined train step: (state, microbatches[M, ...]) ->
     (state, metrics). Loss is the graph-weighted mean over microbatches,
-    the same bookkeeping as the data-parallel step."""
+    the same bookkeeping as the data-parallel step. With the default
+    ``norm="batch"``, feature-norm RUNNING stats update too: one EMA step
+    per microbatch, microbatch-averaged — the same semantics as the
+    data-parallel step's replica-mean update, so a pipelined checkpoint
+    evaluates/fine-tunes identically on the data-parallel path."""
+    collect = norm == "batch"
+    encode = make_pipelined_forward(model, mesh, n_micro, norm=norm,
+                                    collect_stats=collect)
     conv_cls = CONV_REGISTRY[model.spec.mpnn_type]
-    if getattr(conv_cls, "feature_norm", True):
+    if not collect and getattr(conv_cls, "feature_norm", True):
         import warnings
 
         warnings.warn(
-            "pipelined training never updates feature-norm RUNNING stats "
-            "(scale/bias still train; blocks normalize with "
-            f"{'per-microbatch' if norm == 'batch' else 'init running'} "
-            "statistics). A checkpoint fine-tuned or evaluated later on the "
-            "data-parallel path will start from init running stats.",
+            "pipelined training with norm='running' freezes feature-norm "
+            "running stats at their initial values (scale/bias still train).",
             stacklevel=2,
         )
-    encode = make_pipelined_forward(model, mesh, n_micro, norm=norm)
 
     def loss_fn(params, batch_stats, mb: GraphBatch):
         c_params = _cast_floats(params, compute_dtype)
         c_mb = _cast_floats(mb, compute_dtype)
         variables = {"params": c_params, "batch_stats": batch_stats}
-        inv, equiv = encode(variables, c_mb)
+        if collect:
+            inv, equiv, new_stats = encode(variables, c_mb)
+        else:
+            inv, equiv = encode(variables, c_mb)
+            new_stats = batch_stats
 
         def per_micro(inv_m, equiv_m, b, b_raw):
             pred = model.apply(variables, inv_m, equiv_m, b, False,
@@ -275,15 +334,16 @@ def make_pipelined_train_step(
 
         tots, tasks, ngs = jax.vmap(per_micro)(inv, equiv, c_mb, mb)
         denom = jnp.maximum(ngs.sum(), 1.0)
-        return tots.sum() / denom, (tasks.sum(axis=0) / denom, ngs.sum())
+        return tots.sum() / denom, (tasks.sum(axis=0) / denom, ngs.sum(),
+                                    new_stats)
 
     from ..train.step import donate_state_argnums as _donate
 
     @partial(jax.jit, donate_argnums=_donate())
     def train_step(state: TrainState, mb: GraphBatch):
-        (loss, (tasks, ng)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, state.batch_stats, mb
-        )
+        (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.batch_stats, mb)
         from ..train.step import freeze_conv_grads
 
         grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
@@ -292,7 +352,10 @@ def make_pipelined_train_step(
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
             params=new_params,
-            batch_stats=state.batch_stats,  # frozen under pipelining
+            batch_stats=jax.tree.map(
+                lambda x: x.astype(jnp.float32) if hasattr(x, "astype") else x,
+                new_stats,
+            ),
             opt_state=new_opt_state,
             step=state.step + 1,
         )
@@ -303,13 +366,15 @@ def make_pipelined_train_step(
 
 def make_pipelined_eval_step(
     model: HydraModel, mesh: Mesh, n_micro: int,
-    compute_dtype=jnp.float32, norm: str = "batch",
+    compute_dtype=jnp.float32, norm: str = "running",
 ):
     """Pipelined evaluation: same metrics dict as the data-parallel eval step
     (loss, per-task losses, per-head sse/count, graph count) so the epoch
-    loop consumes either interchangeably. ``norm`` defaults to "batch" to
-    match what pipelined TRAINING optimized (running stats never update
-    under pipelining, so eval-mode running averages would be init values)."""
+    loop consumes either interchangeably. ``norm`` defaults to "running" —
+    eval-mode running averages, the data-parallel eval step's semantics.
+    Running stats accumulate during pipelined training (see
+    ``make_pipelined_train_step``), so this keeps the LR scheduler (which
+    steps on val loss) on the same trajectory as a data-parallel run."""
     encode = make_pipelined_forward(model, mesh, n_micro, norm=norm)
 
     @jax.jit

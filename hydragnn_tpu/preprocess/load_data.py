@@ -167,6 +167,7 @@ def create_dataloaders(
     pad: PadSpec | None = None,
     seed: int = 0,
     buckets: int | None = None,
+    attn_cap: int = 0,
 ):
     """Three loaders over a shared pad-bucket table (so the XLA program count
     is bounded by the table size across all splits) and DistributedSampler
@@ -180,11 +181,12 @@ def create_dataloaders(
     # still yields one (smaller) batch per epoch
     batch_size = max(1, min(batch_size, len(trainset) // max(world, 1) or 1))
     bucket_list = (
-        compute_pad_buckets(all_samples, batch_size, max_buckets=buckets)
+        compute_pad_buckets(all_samples, batch_size, max_buckets=buckets,
+                            attn_cap=attn_cap)
         if buckets and buckets > 1
         else None
     )
-    pad = pad or compute_pad_spec(all_samples, batch_size)
+    pad = pad or compute_pad_spec(all_samples, batch_size, attn_cap=attn_cap)
     train_loader = GraphLoader(
         trainset, batch_size, pad=pad, shuffle=True, seed=seed, rank=rank, world=world,
         buckets=bucket_list,
@@ -229,7 +231,8 @@ def dataset_loading_and_splitting(config: dict, samples=None, rank: int = 0, wor
         for s in samples:
             if s.num_edges == 0 and s.num_nodes > 1:
                 build_radius_graph(
-                    s, float(radius), max_neighbours=arch_pre.get("max_neighbours")
+                    s, float(radius), max_neighbours=arch_pre.get("max_neighbours"),
+                    ensure_connected=bool(arch_pre.get("ensure_connected", True)),
                 )
     # edge-length + geometric descriptor columns (reference :152-180):
     # Distance(cat=True) + dataset/processes-global max normalization, then
@@ -297,4 +300,12 @@ def dataset_loading_and_splitting(config: dict, samples=None, rank: int = 0, wor
     return create_dataloaders(
         train, val, test, bs, rank=rank, world=world,
         buckets=int(training.get("pad_buckets", 0) or 0) or None,
+        # a USER-set dense-attention cap (GPS max_graph_nodes) below the
+        # dataset max: collate certifies against it so fitting batches keep
+        # the dense-block path (see PadSpec.attn_cap)
+        attn_cap=(
+            int(arch_cfg.get("max_graph_nodes") or 0)
+            if arch_cfg.get("global_attn_engine")
+            else 0
+        ),
     )

@@ -55,22 +55,26 @@ def _empty_like(batch):
     )
 
 
-def _grouped(loader, n: int, mesh, fill: bool = False):
+def _grouped(loader, n: int, mesh, fill: bool = False, put=None):
     """Group n consecutive batches into one stacked [n, ...] device batch.
     ``fill=True`` pads the trailing partial group with empty (masked-out)
     batches — required for evaluation, where dropping batches would bias the
-    split metrics; training drops the partial group instead."""
+    split metrics; training drops the partial group instead. ``put``
+    overrides the device-placement function (default: data-axis
+    ``put_batch``; the pipeline path passes ``put_microbatches``, which
+    replicates the [n_micro, ...] stack over the stage mesh)."""
     from ..parallel.step import put_batch, stack_device_batches
 
+    put = put or put_batch
     group = []
     for b in loader:
         group.append(b)
         if len(group) == n:
-            yield put_batch(stack_device_batches(group), mesh)
+            yield put(stack_device_batches(group), mesh)
             group = []
     if group and fill:
         group.extend([_empty_like(group[0])] * (n - len(group)))
-        yield put_batch(stack_device_batches(group), mesh)
+        yield put(stack_device_batches(group), mesh)
 
 
 _SENTINEL = object()
@@ -96,23 +100,26 @@ def _local_device_count(mesh) -> int:
 
 
 def train_epoch(
-    train_step, state: TrainState, loader, verbosity: int = 0, mesh=None, put_fn=None
+    train_step, state: TrainState, loader, verbosity: int = 0, mesh=None,
+    put_fn=None, group_n=None, group_put=None,
 ):
     """One training epoch; returns (state, mean loss, per-task mean losses).
     ``put_fn`` (edge-sharded mode) transfers each batch itself — no device
-    grouping; every step consumes ONE batch sharded across the mesh."""
+    grouping; every step consumes ONE batch sharded across the mesh.
+    ``group_n``/``group_put`` override the grouped path's stack size and
+    placement (pipeline mode: n_micro microbatches, replicated)."""
     tot = 0.0
     tasks = None
     n_graphs = 0.0
     nbatch = _max_num_batches(loader)
     grouped = mesh is not None and put_fn is None
-    n_dev = _local_device_count(mesh) if grouped else 1
+    n_dev = (group_n or _local_device_count(mesh)) if grouped else 1
     if grouped:
         # the HYDRAGNN_MAX_NUM_BATCH cap counts raw loader batches; each
         # grouped step consumes n_dev of them
         nbatch = max(1, -(-nbatch // n_dev))
     it = _timed_iter(
-        _grouped(loader, n_dev, mesh)
+        _grouped(loader, n_dev, mesh, put=group_put)
         if grouped
         else iterate_tqdm(loader, verbosity, desc="train", total=nbatch)
     )
@@ -138,7 +145,7 @@ def train_epoch(
 
 def evaluate(
     eval_step, state: TrainState, loader, verbosity: int = 0, span: str = "validate",
-    mesh=None, put_fn=None,
+    mesh=None, put_fn=None, group_n=None, group_put=None,
 ):
     """Full-split evaluation; returns (loss, per-task losses, per-head rmse)."""
     tot = 0.0
@@ -147,9 +154,9 @@ def evaluate(
     count = None
     n_graphs = 0.0
     grouped = mesh is not None and put_fn is None
-    n_dev = _local_device_count(mesh) if grouped else 1
+    n_dev = (group_n or _local_device_count(mesh)) if grouped else 1
     it = (
-        _grouped(loader, n_dev, mesh, fill=True)
+        _grouped(loader, n_dev, mesh, fill=True, put=group_put)
         if grouped
         else iterate_tqdm(loader, verbosity, desc=span, total=len(loader))
     )
@@ -207,6 +214,8 @@ def train_validate_test(
     edge_sharded = bool(config_nn.get("Architecture", {}).get("edge_sharding"))
 
     put_fn = None
+    group_n = None
+    group_put = None
     if mesh is not None and edge_sharded:
         # long-context mode: every batch's EDGE arrays shard across the mesh,
         # nodes replicated; one (possibly giant) batch per step
@@ -248,6 +257,11 @@ def train_validate_test(
         eval_step = make_pipelined_eval_step(
             model, mesh, n_micro=n_micro, compute_dtype=precision
         )
+        # the stage mesh consumes n_micro loader batches per step, stacked
+        # [M, ...] and REPLICATED over the ring — not split over a data axis
+        # (the stage mesh has none)
+        group_n = n_micro
+        group_put = put_microbatches
     elif mesh is not None:
         from ..parallel.step import make_parallel_eval_step, make_parallel_train_step
 
@@ -309,7 +323,8 @@ def train_validate_test(
         os.environ["HYDRAGNN_EPOCH"] = str(epoch)  # exported for tools (reference :316)
         train_loader.set_epoch(epoch)
         state, train_loss, train_tasks = train_epoch(
-            train_step, state, train_loader, verbosity, mesh=mesh, put_fn=put_fn
+            train_step, state, train_loader, verbosity, mesh=mesh, put_fn=put_fn,
+            group_n=group_n, group_put=group_put,
         )
         if profiling and epoch == 0:
             _profiler("stop")
@@ -332,11 +347,11 @@ def train_validate_test(
 
         val_loss, val_tasks, _ = evaluate(
             eval_step, state, val_loader, verbosity, "validate", mesh=mesh,
-            put_fn=put_fn,
+            put_fn=put_fn, group_n=group_n, group_put=group_put,
         )
         test_loss, test_tasks, test_rmse = evaluate(
             eval_step, state, test_loader, verbosity, "test", mesh=mesh,
-            put_fn=put_fn,
+            put_fn=put_fn, group_n=group_n, group_put=group_put,
         )
 
         new_lr = scheduler.step(val_loss)

@@ -202,3 +202,45 @@ def test_static_fused_scatter_removes_fallback(monkeypatch):
         h, bj.senders, bj.receivers, bj.num_nodes, bj.edge_mask
     )
     np.testing.assert_allclose(run(bj), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_attn_cap_certifies_dense_below_node_cap():
+    """A user-set dense-attention width (GPS max_graph_nodes) SMALLER than the
+    dataset max must not force every batch flat: batches whose graphs all fit
+    the cap certify max_n_node == attn_cap; only genuine outliers certify a
+    bigger power-of-two bound (round-3 advisor finding, gps.py:132)."""
+    small = _random_samples(4, seed=3, lo=9, hi=16)    # all graphs < 16 nodes
+    big = _random_samples(4, seed=4, lo=40, hi=50)     # outliers > cap
+    pad = compute_pad_spec(small + big, 4, attn_cap=16)
+    assert pad.node_cap > 16  # the scenario: cap below dataset max
+    b_small = collate(small, pad)
+    assert b_small.meta.max_n_node == 16  # certified at the cap -> dense
+    b_big = collate(big, pad)
+    assert b_big.meta.max_n_node > 16     # outlier: pow2 bound -> flat
+    assert b_big.meta.max_n_node >= max(s.num_nodes for s in big)
+
+
+def test_gs_certificate_dropped_for_non_default_geometry():
+    """BatchMeta.gs_fits is checked against the default (window, block_edges);
+    a caller passing a different geometry must NOT have the certificate
+    honored (it would statically skip the fallback on an uncertified
+    layout) — the wrapper drops it and re-enters the dynamic path."""
+    samples = _random_samples(4, seed=5)
+    pad = compute_pad_spec(samples, 4)
+    b = collate(samples, pad)
+    h = jnp.asarray(np.random.default_rng(0).normal(size=(b.x.shape[0], 8)),
+                    jnp.float32)
+
+    def run(window):
+        return fused_scatter.fused_gather_scatter(
+            h, b.senders, b.receivers, b.x.shape[0],
+            window=window, fits=b.meta.gs_fits, interpret=True,
+        )
+
+    # default geometry honors the certificate; a non-default window must
+    # still produce the same (correct) sums via the dynamic path
+    np.testing.assert_allclose(
+        np.asarray(run(fused_scatter.GS_CERT_WINDOW)),
+        np.asarray(run(128)),
+        rtol=1e-5, atol=1e-5,
+    )
