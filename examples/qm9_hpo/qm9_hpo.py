@@ -76,6 +76,13 @@ def main():
     ap.add_argument("--epochs", type=int, default=None)
     ap.add_argument("--backend", default="random", choices=["random", "optuna"])
     ap.add_argument("--log", default="logs/qm9_hpo/result.json")
+    ap.add_argument("--workers", type=int, default=1,
+                    help=">1 runs trials CONCURRENTLY in separate processes "
+                         "(DeepHyper ProcessPoolEvaluator pattern)")
+    ap.add_argument("--budget", type=float, default=None,
+                    help="walltime budget in seconds: stop launching trials "
+                         "once spent")
+    ap.add_argument("--trial-timeout", type=float, default=600.0)
     args = ap.parse_args()
     if args.trials < 1:
         ap.error("--trials must be >= 1")
@@ -87,24 +94,64 @@ def main():
     from qm9 import synthetic_molecules
 
     import hydragnn_tpu
-    from hydragnn_tpu.utils.hpo import run_hpo
+    from hydragnn_tpu.utils.hpo import run_hpo, subprocess_objective
 
-    samples = synthetic_molecules(args.samples)
+    if args.workers > 1:
+        # concurrent trials: each in its own interpreter via the worker
+        # script. A per-run trial dir keeps the concurrency audit honest
+        # across reruns. Workers are pinned to CPU: this host has ONE TPU
+        # chip, and a second process would hit the exclusive libtpu lock and
+        # burn its trial — multi-accelerator sites assign one chip per worker
+        # via extra_env (TPU_VISIBLE_CHIPS / JAX_PLATFORMS) instead.
+        import shutil
 
-    def objective(cfg) -> float:
-        import copy
+        trial_dir = os.path.join(os.path.dirname(args.log) or ".", "trials")
+        shutil.rmtree(trial_dir, ignore_errors=True)
+        objective = subprocess_objective(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "trial_worker.py"),
+            timeout=args.trial_timeout,
+            extra_env={"QM9_HPO_SAMPLES": str(args.samples),
+                       "JAX_PLATFORMS": "cpu"},
+            keep_dir=trial_dir,
+        )
+    else:
+        samples = synthetic_molecules(args.samples)
 
-        trial_samples = copy.deepcopy(samples)
-        state, model, full_cfg = hydragnn_tpu.run_training(cfg, trial_samples)
-        from hydragnn_tpu.run_prediction import run_prediction
+        def objective(cfg) -> float:
+            import copy
 
-        error, _, _, _ = run_prediction(full_cfg, state, model, samples=trial_samples)
-        return float(error)
+            trial_samples = copy.deepcopy(samples)
+            state, model, full_cfg = hydragnn_tpu.run_training(cfg, trial_samples)
+            from hydragnn_tpu.run_prediction import run_prediction
+
+            error, _, _, _ = run_prediction(
+                full_cfg, state, model, samples=trial_samples
+            )
+            return float(error)
 
     best_cfg, best_val, history = run_hpo(
         BASE_CONFIG, SPACE, objective,
         n_trials=args.trials, backend=args.backend, log_path=args.log,
+        workers=args.workers, walltime_budget=args.budget,
     )
+    if args.workers > 1:
+        # concurrency audit: report how many trial spans overlapped
+        import glob as _glob
+        import json as _json
+
+        spans = []
+        for p in sorted(_glob.glob(os.path.join(trial_dir, "trial_*.json"))):
+            with open(p) as f:
+                rec = _json.load(f)
+            spans.append((rec["t_start"], rec["t_end"]))
+        overlaps = sum(
+            1
+            for i, (s0, e0) in enumerate(spans)
+            for s1, _ in spans[i + 1 :]
+            if s1 < e0
+        )
+        print(f"concurrent spans observed: {overlaps} overlapping trial pairs")
     arch = best_cfg["NeuralNetwork"]["Architecture"]
     print(
         f"best: mpnn_type={arch['mpnn_type']} hidden={arch['hidden_dim']} "

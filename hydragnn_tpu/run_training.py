@@ -47,22 +47,9 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             print_distributed(verbosity, f"multi-host init skipped ({e})")
             world, rank = 1, 0
 
-    # the in-process mesh path stacks device-count groups of batches, which
-    # must share one shape — bucketed padding only applies off that path
-    will_mesh = False
-    try:
-        import jax
-
-        will_mesh = flags.get(flags.AUTO_PARALLEL) and len(jax.devices()) > 1
-    except Exception:
-        pass
-    if will_mesh and training_cfg.get("pad_buckets"):
-        print_distributed(
-            verbosity, "pad_buckets disabled: multi-device grouping needs one bucket"
-        )
-        training_cfg = dict(training_cfg)
-        config["NeuralNetwork"]["Training"] = training_cfg
-        training_cfg["pad_buckets"] = 0
+    # bucketed padding composes with the in-process mesh path: the epoch loop
+    # registers its device-group size on the loaders (GraphLoader.set_group),
+    # which coarsens the bucket choice to one shape per stacked group
 
     # data loading + split (reference :90)
     train_loader, val_loader, test_loader = dataset_loading_and_splitting(

@@ -297,6 +297,15 @@ def train_validate_test(
         if training.get("EarlyStopping", False)
         else None
     )
+    # multi-device grouping contract: tell the loaders how many consecutive
+    # batches stack into one device batch, so bucketed padding coarsens its
+    # bucket choice per GROUP (one shape per stack) instead of being disabled
+    if mesh is not None and put_fn is None:
+        n_stack = group_n or _local_device_count(mesh)
+        for ld in (train_loader, val_loader, test_loader):
+            if hasattr(ld, "set_group"):
+                ld.set_group(n_stack)
+
     skip_valtest = not flags.get(flags.VALTEST)
     # a dataset too small (or perc_train=1.0) can leave val/test empty —
     # train-only in that case instead of crashing

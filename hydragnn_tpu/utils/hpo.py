@@ -11,11 +11,78 @@ when it is installed (``backend="optuna"``).
 from __future__ import annotations
 
 import copy
+import itertools
 import json
 import os
+import time
 from typing import Any, Callable
 
 import numpy as np
+
+
+def subprocess_objective(
+    worker: str,
+    timeout: float = 600.0,
+    python: str | None = None,
+    extra_env: dict | None = None,
+    keep_dir: str | None = None,
+) -> Callable[[dict], float]:
+    """Trial evaluator that runs each configuration in its OWN OS process —
+    the reference's DeepHyper ``ProcessPoolEvaluator``/srun pattern
+    (``examples/multidataset_hpo/gfm_deephyper_multi.py:127-170``). Pass the
+    returned callable to ``run_hpo(..., workers=N)`` for N concurrent trials:
+    the thread pool just supervises; the training itself runs in separate
+    interpreters, so JAX state never collides across trials.
+
+    ``worker`` is a script invoked as ``python worker config.json out.json``
+    that trains the config and writes ``{"objective": <float>}``. A trial
+    that overruns ``timeout``, crashes, or writes garbage scores ``inf``
+    (diverged-trial semantics — never beats a finite value). ``keep_dir``
+    saves each trial's record (objective, wall-clock span, returncode) as
+    ``trial_<n>.json`` for post-hoc analysis/concurrency audits."""
+    import subprocess
+    import sys
+
+    counter = itertools.count()
+
+    def objective(cfg: dict) -> float:
+        import tempfile
+
+        idx = next(counter)
+        t0 = time.time()
+        value, rc, err = float("inf"), None, None
+        with tempfile.TemporaryDirectory() as td:
+            cfg_path = os.path.join(td, "config.json")
+            out_path = os.path.join(td, "out.json")
+            with open(cfg_path, "w") as f:
+                json.dump(cfg, f)
+            env = dict(os.environ)
+            env.update(extra_env or {})
+            try:
+                r = subprocess.run(
+                    [python or sys.executable, worker, cfg_path, out_path],
+                    timeout=timeout, capture_output=True, text=True, env=env,
+                )
+                rc = r.returncode
+                if rc == 0:
+                    with open(out_path) as f:
+                        value = float(json.load(f)["objective"])
+                else:
+                    err = r.stderr[-2000:]
+            except Exception as exc:  # timeout, missing/garbled out.json, ...
+                err = f"{type(exc).__name__}: {exc}"
+        t1 = time.time()
+        if keep_dir:
+            os.makedirs(keep_dir, exist_ok=True)
+            with open(os.path.join(keep_dir, f"trial_{idx:03d}.json"), "w") as f:
+                json.dump(
+                    {"objective": value, "t_start": t0, "t_end": t1,
+                     "returncode": rc, "error": err},
+                    f,
+                )
+        return value
+
+    return objective
 
 
 def sample_config(space: dict[str, Any], rng: np.random.Generator) -> dict:
@@ -54,6 +121,7 @@ def run_hpo(
     backend: str = "random",
     log_path: str | None = None,
     workers: int = 1,
+    walltime_budget: float | None = None,
 ) -> tuple[dict, float, list]:
     """Minimize ``objective(config)`` over ``space``. Space keys are dotted
     config paths (e.g. ``"NeuralNetwork.Architecture.hidden_dim"``).
@@ -62,8 +130,14 @@ def run_hpo(
     ``workers > 1`` evaluates random-search trials concurrently through a
     thread pool (the reference's DeepHyper ProcessPoolEvaluator width,
     ``examples/multidataset_hpo/gfm_deephyper_multi.py``) — the objective
-    must be thread-safe, e.g. a subprocess launcher."""
+    must be thread-safe, e.g. ``subprocess_objective``. ``walltime_budget``
+    (seconds) stops LAUNCHING new trials once spent; in-flight trials finish
+    and count."""
     history = []
+    deadline = time.monotonic() + walltime_budget if walltime_budget else None
+
+    def expired() -> bool:
+        return deadline is not None and time.monotonic() > deadline
 
     def build(assignment: dict) -> dict:
         cfg = copy.deepcopy(base_config)
@@ -93,29 +167,55 @@ def run_hpo(
             return value
 
         study = optuna.create_study(direction="minimize")
-        study.optimize(opt_objective, n_trials=n_trials, n_jobs=max(workers, 1))
+        # optuna implements the walltime budget natively (stops launching new
+        # trials once spent — same semantics as the random branch below)
+        study.optimize(opt_objective, n_trials=n_trials,
+                       n_jobs=max(workers, 1), timeout=walltime_budget)
         best_assignment = study.best_params
         best_value = study.best_value
     else:
         rng = np.random.default_rng(seed)
         assignments = [sample_config(space, rng) for _ in range(n_trials)]
+        values: list = [None] * n_trials
         if workers > 1:
-            from concurrent.futures import ThreadPoolExecutor
+            from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 
             with ThreadPoolExecutor(max_workers=workers) as pool:
-                values = list(pool.map(lambda a: float(objective(build(a))), assignments))
+                pending: dict = {}
+                i = 0
+                while i < n_trials or pending:
+                    while i < n_trials and len(pending) < workers and not expired():
+                        fut = pool.submit(
+                            lambda a: float(objective(build(a))), assignments[i]
+                        )
+                        pending[fut] = i
+                        i += 1
+                    if not pending:
+                        break
+                    done, _ = wait(pending, return_when=FIRST_COMPLETED)
+                    for fut in done:
+                        values[pending.pop(fut)] = fut.result()
+                    if expired():
+                        i = n_trials  # budget spent: drain in-flight, launch no more
         else:
-            values = [float(objective(build(a))) for a in assignments]
+            for i, a in enumerate(assignments):
+                if expired():
+                    break
+                values[i] = float(objective(build(a)))
         best_assignment, best_value = None, float("inf")
+        launched = 0
         for assignment, value in zip(assignments, values):
+            if value is None:
+                continue  # budget cap: trial never launched
+            launched += 1
             history.append({"assignment": assignment, "value": value})
             # NaN/inf objectives (diverged trials) never beat any finite value
             if np.isfinite(value) and value < best_value:
                 best_assignment, best_value = assignment, value
         if best_assignment is None:
             raise RuntimeError(
-                f"all {n_trials} HPO trials returned non-finite objectives "
-                f"(history: {[h['value'] for h in history]})"
+                f"all {launched} launched HPO trials returned non-finite "
+                f"objectives (history: {[h['value'] for h in history]})"
             )
 
     if log_path:

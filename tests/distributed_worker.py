@@ -89,6 +89,14 @@ def main() -> None:
 
     if mode == "fsdp":
         os.environ["HYDRAGNN_USE_FSDP"] = "1"
+    if mode in ("syncbn", "nosyncbn"):
+        # global SyncBatchNorm semantics (reference distributed.py:414-416):
+        # batch statistics pmean across the WHOLE mesh data axis, not just
+        # the process-local shard — proven by comparing runs below
+        CONFIG["NeuralNetwork"]["Architecture"]["SyncBatchNorm"] = (
+            mode == "syncbn"
+        )
+        CONFIG["NeuralNetwork"]["Training"]["num_epoch"] = 1
     if mode == "packed":
         # cross-host data plane: rank 0 writes the packed store, a global
         # barrier publishes it, then EVERY rank reads lazily with per-epoch
@@ -127,8 +135,18 @@ def main() -> None:
     for leaf in jax.tree.leaves(state.params):
         shard = np.asarray(leaf.addressable_shards[0].data)
         total += float(np.abs(shard).sum())
+    out = {"rank": rank, "param_l1": total}
+    if mode in ("syncbn", "nosyncbn"):
+        # final feature-norm running stats: the VARIANCE distinguishes global
+        # sync (var of the union batch) from replica-local stats (mean of
+        # per-replica vars) — the running MEAN is linear in the batch stat
+        # and matches either way
+        var = state.batch_stats["feature_norm_0"]["var"]
+        if hasattr(var, "addressable_shards"):
+            var = var.addressable_shards[0].data
+        out["bn_var"] = [float(v) for v in np.asarray(var).ravel()]
     with open(os.path.join(outdir, f"rank{rank}.json"), "w") as f:
-        json.dump({"rank": rank, "param_l1": total}, f)
+        json.dump(out, f)
 
 
 if __name__ == "__main__":

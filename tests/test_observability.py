@@ -196,3 +196,50 @@ def test_device_memory_summary_is_robust():
 
     s = device_memory_summary()
     assert isinstance(s, str) and s  # CPU backend: explanatory fallback text
+
+
+def test_hpo_walltime_budget_stops_launching():
+    """walltime_budget: once spent, no NEW trials launch; in-flight finish."""
+    import time as _time
+
+    calls = []
+
+    def slow_objective(cfg):
+        calls.append(1)
+        _time.sleep(0.3)
+        return float(cfg["x"])
+
+    base = {"x": 0.0}
+    space = {"x": ("float", 0.0, 1.0)}
+    best, val, hist = run_hpo(
+        base, space, slow_objective, n_trials=50, seed=2, walltime_budget=1.0
+    )
+    assert 1 <= len(calls) < 50
+    assert len(hist) == len(calls)
+    assert np.isfinite(val)
+
+
+def test_subprocess_objective_crash_and_timeout_score_inf(tmp_path):
+    from hydragnn_tpu.utils.hpo import subprocess_objective
+
+    crash = tmp_path / "crash.py"
+    crash.write_text("import sys; sys.exit(3)\n")
+    obj = subprocess_objective(str(crash), timeout=30, keep_dir=str(tmp_path / "k"))
+    assert obj({"a": 1}) == float("inf")
+
+    slow = tmp_path / "slow.py"
+    slow.write_text("import time; time.sleep(60)\n")
+    obj2 = subprocess_objective(str(slow), timeout=1)
+    assert obj2({"a": 1}) == float("inf")
+
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import json, sys\n"
+        "cfg = json.load(open(sys.argv[1]))\n"
+        "json.dump({'objective': cfg['a'] * 2.0}, open(sys.argv[2], 'w'))\n"
+    )
+    obj3 = subprocess_objective(str(ok), timeout=30, keep_dir=str(tmp_path / "k2"))
+    assert obj3({"a": 2}) == 4.0
+    assert obj3({"a": 5}) == 10.0
+    recs = sorted((tmp_path / "k2").glob("trial_*.json"))
+    assert len(recs) == 2  # one record per trial of THIS evaluator
