@@ -5,6 +5,7 @@ from .xyz import load_xyz_dir, read_xyz_file
 from .cfg import load_cfg_dir, read_cfg_file
 from .pickledataset import SimplePickleDataset, SimplePickleWriter
 from .packed import PackedDataset, PackedWriter
+from .sharded import ShardedStore
 
 
 import os

@@ -480,13 +480,35 @@ class GraphLoader:
             # per-step picks from the shared permutation, so the coarsened
             # choice stays SPMD shape-aligned too.
             for i in range(0, len(plan), self.group):
-                members = plan[i : i + self.group]
-                pad = max((p for _, p in members), key=lambda p: p.as_tuple())
+                members = [p for _, p in plan[i : i + self.group]]
+                # component-wise max: correct even for NON-nested bucket
+                # lists a caller supplies (a lexicographic max could pick a
+                # spec that underfits another member's edge count)
+                pad = members[0]
+                if any(m is not members[0] for m in members):
+                    pad = PadSpec(
+                        n_node=max(m.n_node for m in members),
+                        n_edge=max(m.n_edge for m in members),
+                        n_graph=max(m.n_graph for m in members),
+                        n_triplet=max(m.n_triplet for m in members),
+                        node_cap=members[0].node_cap,
+                        attn_cap=members[0].attn_cap,
+                    )
+                    # reuse an existing bucket when one already dominates —
+                    # keeps the compile count bounded by the table size
+                    for b in self.buckets:
+                        if b.as_tuple() == pad.as_tuple():
+                            pad = b
+                            break
                 for j in range(i, i + len(members)):
                     plan[j] = (plan[j][0], pad)
         return plan
 
     def collate_chunk(self, chunk: np.ndarray, pad: PadSpec) -> GraphBatch:
+        if hasattr(self.samples, "fetch"):
+            # batched store read: remote samples cost one request per owning
+            # host instead of one per sample (datasets.sharded.ShardedStore)
+            return collate(self.samples.fetch(chunk), pad)
         return collate([self.samples[i] for i in chunk], pad)
 
     def __iter__(self) -> Iterable[GraphBatch]:

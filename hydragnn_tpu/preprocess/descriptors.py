@@ -226,33 +226,19 @@ def attach_atomic_descriptors(sample, descriptors: AtomicDescriptors, z_column: 
 
 
 def xyz2mol(atoms, coordinates, **kwargs):
-    """Bond perception from raw coordinates (reference ``xyz2mol.py``) —
-    requires rdkit, which is not installable in this environment."""
-    try:
-        from rdkit import Chem  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "xyz2mol requires rdkit (bond perception has no numpy-only "
-            "equivalent). Install rdkit or precompute bonds offline and load "
-            "them as edge indices."
-        ) from e
-    raise NotImplementedError(
-        "rdkit is importable but the xyz2mol port is not wired; precompute "
-        "molecules offline with the reference implementation"
-    )
+    """Bond perception from raw coordinates (reference ``xyz2mol.py``'s Kim &
+    Jensen algorithm) — numpy-native implementation, no rdkit needed; see
+    ``preprocess.molgraph`` for the full API (connectivity, bond orders,
+    formal charges, GraphSample conversion)."""
+    from .molgraph import xyz2mol as _impl
+
+    return _impl(atoms, coordinates, **kwargs)
 
 
 def smiles_to_graph(smiles: str, **kwargs):
-    """SMILES -> graph sample (reference ``smiles_utils.py``) — requires
-    rdkit for parsing; see ``xyz2mol`` for the offline route."""
-    try:
-        from rdkit import Chem  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "smiles_to_graph requires rdkit to parse SMILES. Precompute the "
-            "graphs offline (e.g. with the reference's smiles_utils) and load "
-            "them via the packed/pickle datasets."
-        ) from e
-    raise NotImplementedError(
-        "rdkit is importable but the SMILES featurizer port is not wired"
-    )
+    """SMILES -> GraphSample (reference ``smiles_utils.py``) — numpy-native
+    parser with kekulization + implicit hydrogens (``preprocess.molgraph``);
+    node features [Z, n_H, aromatic, formal_charge], bond-order edges."""
+    from .molgraph import smiles_to_graphsample
+
+    return smiles_to_graphsample(smiles, **kwargs)

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Strong-scaling sweep (reference SC25-job-strong.sh): GLOBAL batch held
+# fixed while node count grows — step_ms should shrink ~linearly until
+# collectives dominate.
+#   sbatch -N <nodes> run-scripts/job-strong.sh
+#SBATCH -J hydragnn-tpu-strong
+#SBATCH -o job-%j.out
+#SBATCH -t 00:30:00
+#SBATCH --ntasks-per-node=1
+
+set -eu
+
+GLOBAL_BATCH=${GLOBAL_BATCH:-4096}
+STEPS=${STEPS:-30}
+export HYDRAGNN_VALTEST=0
+
+srun python run-scripts/scaling_driver.py \
+    --global-batch "${GLOBAL_BATCH}" --steps "${STEPS}" \
+    --hidden 256 --layers 6 --precision bf16

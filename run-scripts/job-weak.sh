@@ -1,0 +1,28 @@
+#!/bin/bash
+# Weak-scaling sweep on a TPU pod slice (the reference's SC25-job-weak.sh for
+# Frontier, translated to a jax.distributed launch): one Python process per
+# host, per-device batch held FIXED while node count grows — the
+# graphs_per_sec_per_device line should stay flat.
+#
+# SLURM (CPU/GPU clusters or TPU-with-SLURM):
+#   sbatch -N <nodes> run-scripts/job-weak.sh
+# GCE TPU pods: run the srun line below once per worker with
+#   JAX_COORDINATOR_ADDRESS=<worker0-ip>:8476 (jax.distributed picks the
+#   rank/world from the TPU runtime automatically).
+#SBATCH -J hydragnn-tpu-weak
+#SBATCH -o job-%j.out
+#SBATCH -t 00:30:00
+#SBATCH --ntasks-per-node=1
+
+set -eu
+
+BATCH_PER_DEVICE=${BATCH_PER_DEVICE:-256}
+STEPS=${STEPS:-30}
+export HYDRAGNN_VALTEST=0
+
+# scaling_driver resolves rank/world/coordinator from the scheduler env
+# cascade (SLURM_PROCID/SLURM_NTASKS/nodelist -> parallel/distributed.py),
+# matching the reference's MPI env handling
+srun python run-scripts/scaling_driver.py \
+    --batch "${BATCH_PER_DEVICE}" --steps "${STEPS}" \
+    --hidden 256 --layers 6 --precision bf16

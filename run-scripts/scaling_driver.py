@@ -1,0 +1,184 @@
+"""Multi-host scaling measurement driver (the reference's SC25 scaling
+harness, ``run-scripts/SC25-job-weak.sh`` / ``SC25-job-strong.sh`` +
+``examples/multidataset/train.py`` timing): one process per host joins
+``jax.distributed``, trains steady-state steps on the global data mesh, and
+rank 0 prints ONE JSON line::
+
+    {"metric": "scaling_throughput", "hosts": P, "devices": D,
+     "graphs_per_sec_per_device": X, "graphs_per_sec_total": Y,
+     "step_ms": Z, "batch_per_device": B}
+
+Weak scaling: fixed --batch per device, growing -N; the per-device number
+should hold flat. Strong scaling: fix the GLOBAL batch with
+--global-batch and grow -N.
+
+Launch (SLURM): see job-weak.sh / job-strong.sh next to this file.
+Local 2-process smoke (what CI runs)::
+
+    python run-scripts/scaling_driver.py --coordinator 127.0.0.1:1234 \
+        --rank 0 --world 2 &
+    python run-scripts/scaling_driver.py --coordinator 127.0.0.1:1234 \
+        --rank 1 --world 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port; default = scheduler env cascade")
+    ap.add_argument("--rank", type=int, default=None)
+    ap.add_argument("--world", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=64,
+                    help="per-device batch size (weak scaling)")
+    ap.add_argument("--global-batch", type=int, default=None,
+                    help="global batch size (strong scaling; overrides --batch)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--samples", type=int, default=2048)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--arch", default="GIN")
+    ap.add_argument("--precision", default="bf16")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu for local smoke)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if args.coordinator:
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.world,
+            process_id=args.rank,
+        )
+    else:
+        from hydragnn_tpu.parallel.distributed import setup_ddp
+
+        try:
+            setup_ddp(0)
+        except Exception as e:
+            print(f"single-process run ({e})", file=sys.stderr)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.parallel import make_mesh, shard_state, stack_device_batches
+    from hydragnn_tpu.parallel.step import make_parallel_train_step, put_batch
+    from hydragnn_tpu.train import create_train_state, select_optimizer
+    from hydragnn_tpu.train.step import resolve_precision
+
+    rank = jax.process_index()
+    world = jax.process_count()
+    n_dev = jax.device_count()
+    n_local = len(jax.local_devices())
+    per_dev = (
+        max(args.global_batch // n_dev, 1) if args.global_batch else args.batch
+    )
+
+    cfg = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "scaling",
+            "format": "unit_test",
+            "node_features": {"name": ["type", "x", "x2", "x3"],
+                              "dim": [1, 1, 1, 1],
+                              "column_index": [0, 1, 2, 3]},
+            "graph_features": {"name": ["sum"], "dim": [1], "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": args.arch, "radius": 2.0, "max_neighbours": 20,
+                "hidden_dim": args.hidden, "num_conv_layers": args.layers,
+                "output_heads": {"graph": {
+                    "num_sharedlayers": 2, "dim_sharedlayers": 32,
+                    "num_headlayers": 2, "dim_headlayers": [64, 64]}},
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0], "output_index": [0],
+                "type": ["graph"], "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 1, "batch_size": per_dev,
+                "loss_function_type": "mse", "perc_train": 1.0,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        },
+    }
+
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.preprocess import apply_variables_of_interest
+
+    samples = deterministic_graph_data(
+        number_configurations=args.samples, seed=17
+    )
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    precision = resolve_precision(args.precision)
+
+    loader = GraphLoader(samples, per_dev, shuffle=True, rank=rank, world=world)
+    host_batches = []
+    it = iter(loader)
+    for _ in range(max(args.steps, 8)):
+        try:
+            host_batches.append(next(it))
+        except StopIteration:
+            break
+    # stack this host's n_local batches per step; put_batch assembles global
+    groups = [
+        stack_device_batches(host_batches[i : i + n_local])
+        for i in range(0, len(host_batches) - n_local + 1, n_local)
+    ]
+    if not groups:
+        raise SystemExit("not enough data for one grouped step; raise --samples")
+
+    mesh = make_mesh()
+    state = shard_state(create_train_state(model, optimizer, host_batches[0]), mesh)
+    step = make_parallel_train_step(model, optimizer, mesh, compute_dtype=precision)
+    dev_groups = [put_batch(g, mesh) for g in groups]
+
+    for i in range(max(args.warmup, 1)):  # >=1: the compile must not be timed
+        state, metrics = step(state, dev_groups[i % len(dev_groups)])
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, metrics = step(state, dev_groups[i % len(dev_groups)])
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    graphs_per_step = per_dev * n_dev
+    total = args.steps * graphs_per_step / dt
+    if rank == 0:
+        print(json.dumps({
+            "metric": "scaling_throughput",
+            "hosts": world,
+            "devices": n_dev,
+            "graphs_per_sec_per_device": round(total / n_dev, 2),
+            "graphs_per_sec_total": round(total, 2),
+            "step_ms": round(1e3 * dt / args.steps, 3),
+            "batch_per_device": per_dev,
+            "arch": args.arch,
+            "precision": args.precision,
+        }))
+
+
+if __name__ == "__main__":
+    main()

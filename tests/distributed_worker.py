@@ -97,6 +97,27 @@ def main() -> None:
             mode == "syncbn"
         )
         CONFIG["NeuralNetwork"]["Training"]["num_epoch"] = 1
+    if mode == "sharded":
+        # NON-shared-FS data plane: each rank writes ONLY ITS OWN shard to
+        # its private dir, then ShardedStore exchanges addresses through
+        # process_allgather and serves remote samples over TCP — training
+        # still sees the whole corpus with per-epoch global shuffle
+        from hydragnn_tpu.datasets.packed import PackedWriter
+        from hydragnn_tpu.datasets.sharded import ShardedStore
+
+        half = len(samples) // 2
+        lo, hi = (0, half) if rank == 0 else (half, len(samples))
+        private = os.path.join(outdir, f"host{rank}_local")
+        os.makedirs(private, exist_ok=True)
+        shard_path = os.path.join(private, "shard.gpk")
+        PackedWriter(samples[lo:hi], shard_path)
+        store = ShardedStore(shard_path, lo, hi, advertise_host="127.0.0.1")
+        assert len(store) == len(samples)
+        # cross-host read: this rank can fetch a sample the OTHER rank owns
+        probe = store[0 if rank == 1 else len(samples) - 1]
+        assert probe.num_nodes > 0
+        samples = store
+
     if mode == "packed":
         # cross-host data plane: rank 0 writes the packed store, a global
         # barrier publishes it, then EVERY rank reads lazily with per-epoch

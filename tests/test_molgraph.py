@@ -1,0 +1,169 @@
+"""Molecular graph perception without rdkit: the reference xyz2mol's
+covalent-radius connectivity + valence bond orders + octet formal charges
+(``hydragnn/utils/descriptors_and_embeddings/xyz2mol.py``) and the
+smiles_utils SMILES -> graph featurization, as pure numpy."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.preprocess.molgraph import (
+    Mol,
+    assign_bond_orders,
+    mol_to_graphsample,
+    parse_smiles,
+    perceive_connectivity,
+    smiles_to_graphsample,
+    xyz2mol,
+)
+
+
+def test_connectivity_covalent_radii():
+    ac = perceive_connectivity(
+        ["O", "H", "H"], [[0, 0, 0], [0.96, 0, 0], [-0.24, 0.93, 0]]
+    )
+    assert ac.tolist() == [[0, 1, 1], [1, 0, 0], [1, 0, 0]]
+    # far atoms: no bond
+    ac = perceive_connectivity(["C", "C"], [[0, 0, 0], [3.0, 0, 0]])
+    assert ac.sum() == 0
+
+
+@pytest.mark.parametrize(
+    "atoms,pos,bonds,charges",
+    [
+        (["O", "H", "H"], [[0, 0, 0], [0.96, 0, 0], [-0.24, 0.93, 0]],
+         [(0, 1, 1), (0, 2, 1)], [0, 0, 0]),
+        (["O", "C", "O"], [[-1.16, 0, 0], [0, 0, 0], [1.16, 0, 0]],
+         [(0, 1, 2), (1, 2, 2)], [0, 0, 0]),
+        (["N", "N"], [[0, 0, 0], [1.10, 0, 0]], [(0, 1, 3)], [0, 0]),
+        (["S", "H", "H"], [[0, 0, 0], [1.34, 0, 0], [-0.3, 1.3, 0]],
+         [(0, 1, 1), (0, 2, 1)], [0, 0, 0]),
+        (["C", "O"], [[0, 0, 0], [1.13, 0, 0]], [(0, 1, 3)], [-1, 1]),
+    ],
+)
+def test_xyz2mol_known_molecules(atoms, pos, bonds, charges):
+    m = xyz2mol(atoms, pos)
+    assert m.bonds == bonds
+    assert m.formal_charges.tolist() == charges
+
+
+def test_xyz2mol_ethylene_double_bond():
+    pos = [[0, 0, 0], [1.33, 0, 0], [-0.55, 0.92, 0], [-0.55, -0.92, 0],
+           [1.88, 0.92, 0], [1.88, -0.92, 0]]
+    m = xyz2mol(["C", "C", "H", "H", "H", "H"], pos)
+    assert {b[:2]: b[2] for b in m.bonds}[(0, 1)] == 2
+    assert m.formal_charges.tolist() == [0] * 6
+
+
+def test_smiles_benzene_kekulized():
+    m = parse_smiles("c1ccccc1")
+    assert len(m.atomic_numbers) == 6
+    assert sum(1 for b in m.bonds if b[2] == 2) == 3  # alternating
+    assert m.n_hydrogens.tolist() == [1] * 6
+    assert m.aromatic.all()
+
+
+def test_smiles_pyridine_vs_pyrrole_nitrogen():
+    pyr = parse_smiles("c1ccncc1")  # pyridine N: no H, takes a pi bond
+    n_idx = int(np.flatnonzero(pyr.atomic_numbers == 7)[0])
+    assert pyr.n_hydrogens[n_idx] == 0
+    assert sum(1 for b in pyr.bonds if b[2] == 2) == 3
+    pyl = parse_smiles("c1cc[nH]c1")  # pyrrole N: declared H, lone pair in ring
+    n_idx = int(np.flatnonzero(pyl.atomic_numbers == 7)[0])
+    assert pyl.n_hydrogens[n_idx] == 1
+    assert sum(1 for b in pyl.bonds if b[2] == 2) == 2
+
+
+def test_smiles_fused_rings_and_branches():
+    naph = parse_smiles("c1ccc2ccccc2c1")
+    assert len(naph.atomic_numbers) == 10
+    assert sum(1 for b in naph.bonds if b[2] == 2) == 5
+    tol = parse_smiles("Cc1ccccc1")
+    assert len(tol.atomic_numbers) == 7
+    acetic = parse_smiles("CC(=O)O")
+    orders = {b[:2]: b[2] for b in acetic.bonds}
+    assert orders[(1, 2)] == 2
+    assert acetic.n_hydrogens.tolist() == [3, 0, 0, 1]
+
+
+def test_smiles_bracket_atoms_and_charges():
+    m = parse_smiles("[NH4+]")
+    assert m.formal_charges.tolist() == [1]
+    assert m.n_hydrogens.tolist() == [4]
+    m = parse_smiles("[O-]C=O")  # formate-ish
+    assert m.formal_charges.tolist()[0] == -1
+    with pytest.raises(ValueError, match="unclosed ring"):
+        parse_smiles("c1ccccc")
+    with pytest.raises(ValueError, match="unsupported"):
+        parse_smiles("C$C")
+
+
+def test_graphsample_conversion_smiles_and_xyz():
+    g = smiles_to_graphsample("CC(=O)O")
+    assert g.x.shape == (4, 4)  # [Z, n_H, aromatic, charge]
+    assert g.senders.shape[0] == 6  # 3 bonds, both directions
+    assert set(g.edge_attr.ravel().tolist()) == {1.0, 2.0}
+    m = xyz2mol(["O", "H", "H"], [[0, 0, 0], [0.96, 0, 0], [-0.24, 0.93, 0]])
+    g2 = mol_to_graphsample(m)
+    assert g2.num_nodes == 3 and g2.num_edges == 4
+    assert g2.pos.shape == (3, 3)
+
+
+def test_descriptors_wrappers_route_to_molgraph():
+    from hydragnn_tpu.preprocess.descriptors import smiles_to_graph, xyz2mol as x2m
+
+    g = smiles_to_graph("c1ccccc1")
+    assert g.num_nodes == 6
+    m = x2m(["N", "N"], [[0, 0, 0], [1.10, 0, 0]])
+    assert isinstance(m, Mol) and m.bonds == [(0, 1, 3)]
+
+
+def test_trainable_from_smiles():
+    """End-to-end: a dataset built from SMILES strings trains through the
+    public entry (the reference's dftb/smiles workflow shape)."""
+    import copy
+
+    import hydragnn_tpu
+
+    smiles = ["C", "CC", "CCC", "CCO", "CC(=O)O", "c1ccccc1", "CCN", "CO",
+              "CCCC", "c1ccncc1", "CC(C)C", "CCS"] * 4
+    samples = []
+    for s in smiles:
+        g = smiles_to_graphsample(s)
+        g.graph_y = np.array([float(g.num_nodes)], np.float32)
+        g.extras["node_table"] = np.asarray(g.x)
+        g.extras["graph_table"] = np.asarray(g.graph_y)
+        samples.append(g)
+    cfg = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "smiles_unit",
+            "format": "unit_test",
+            "node_features": {"name": ["z", "nh", "arom", "q"],
+                              "dim": [1, 1, 1, 1],
+                              "column_index": [0, 1, 2, 3]},
+            "graph_features": {"name": ["natoms"], "dim": [1],
+                               "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN", "radius": 2.0, "max_neighbours": 10,
+                "hidden_dim": 16, "num_conv_layers": 2,
+                "output_heads": {"graph": {
+                    "num_sharedlayers": 1, "dim_sharedlayers": 8,
+                    "num_headlayers": 1, "dim_headlayers": [16]}},
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0, 1, 2, 3],
+                "output_index": [0], "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 4, "batch_size": 8, "perc_train": 0.8,
+                "loss_function_type": "mse",
+                "Optimizer": {"type": "AdamW", "learning_rate": 5e-3},
+            },
+        },
+    }
+    state, model, aug = hydragnn_tpu.run_training(copy.deepcopy(cfg), samples=samples)
+    assert state is not None
