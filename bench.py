@@ -390,6 +390,75 @@ def bench_mlip(batch_size: int, bench_steps: int, warmup: int) -> dict:
     )
 
 
+def bench_pallas_validate() -> dict:
+    """HARDWARE validation of the fused gather-scatter kernel (round-3
+    verdict #1's third demand): numeric parity fused-vs-XLA on the real
+    backend at realistic shapes, plus behavior at the VMEM resident limit —
+    a large bucket must STATICALLY fall back (correctness by construction)
+    while an in-budget bucket runs the kernel. Interpret-mode on CPU has
+    looser tiling rules, so only a TPU run of this row proves the kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.fused_scatter import (
+        _static_ok,
+        fused_gather_scatter,
+        reference_gather_scatter,
+    )
+
+    from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+
+    rng = np.random.default_rng(0)
+    rec: dict = {"workload": "pallas_validate",
+                 "backend": jax.default_backend()}
+
+    def one_case(n_samples, c, batch_size):
+        """REAL collate layout (per-sample edge locality, receiver-sorted,
+        host-certified gs_fits) — uniform-random ids would violate the
+        256-window contract and silently compare the XLA path with itself."""
+        samples = make_qm9_like_samples(n_samples, seed=3)
+        pad = compute_pad_spec(samples, batch_size)
+        b = collate(samples[:batch_size], pad)
+        n = b.x.shape[0]
+        fits = bool(b.meta.gs_fits) if b.meta is not None else None
+        h = jnp.asarray(rng.normal(size=(n, c)), jnp.float32)
+        snd = jnp.asarray(b.senders)
+        rcv = jnp.asarray(b.receivers)
+        w = jnp.asarray(np.asarray(b.edge_mask), jnp.float32)
+        kernel_engaged = bool(_static_ok(h, snd, n, 256)) and bool(fits)
+        out_f = jax.jit(
+            lambda h, s, r, w: fused_gather_scatter(h, s, r, n, w, fits=fits)
+        )(h, snd, rcv, w)
+        out_r = jax.jit(
+            lambda h, s, r, w: reference_gather_scatter(h, s, r, n, w)
+        )(h, snd, rcv, w)
+        err = float(
+            jnp.max(jnp.abs(out_f.astype(jnp.float32) - out_r.astype(jnp.float32)))
+        )
+        denom = float(jnp.max(jnp.abs(out_r))) or 1.0
+        return {"certified_fits": fits, "kernel_engaged": kernel_engaged,
+                "n_node": int(n), "max_abs_err": err,
+                "max_rel_err": err / denom}
+
+    # typical bucket: certified layout inside the VMEM budget -> the KERNEL
+    # path runs (statically, fits=True) and must match XLA numerically
+    rec["typical"] = one_case(192, 64, 128)
+    # wide-feature case ABOVE the VMEM resident limit (2*n*c*4 bytes):
+    # the wrapper must STATICALLY fall back even with a certified layout
+    rec["vmem_limit"] = one_case(3072, 1024, 2048)
+    rec["vmem_limit"]["expected_fallback"] = True
+    ok = (
+        rec["typical"]["max_rel_err"] < 1e-4
+        and rec["vmem_limit"]["max_rel_err"] < 1e-4
+        and rec["typical"]["certified_fits"] is True
+        and not rec["vmem_limit"]["kernel_engaged"]
+    )
+    if jax.default_backend() == "tpu":
+        ok = ok and rec["typical"]["kernel_engaged"]
+    rec["parity_ok"] = bool(ok)
+    return rec
+
+
 def _prev_value() -> float | None:
     def _round_no(path: str) -> int:
         m = re.search(r"BENCH_r(\d+)\.json", path)
@@ -489,6 +558,8 @@ def child_main(status_path: str) -> None:
                     os.environ["HYDRAGNN_FUSED_SCATTER"] = prev_flag
 
         plan.append(("fused_ab", fused_ab))
+    if os.getenv("BENCH_PALLAS_VALIDATE", "1") != "0":
+        plan.append(("pallas_validate", bench_pallas_validate))
 
     done: set = set()
     for name, fn in plan:

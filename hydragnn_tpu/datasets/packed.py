@@ -211,6 +211,16 @@ class PackedDataset:
             s.extras["graph_table"] = gt[:, 0]
         return s
 
+    def sample_sizes(self, indices) -> np.ndarray:
+        """[k, 2] (num_nodes, num_edges) per sample straight from the
+        count index — size queries (bucket planning) never materialize
+        sample content."""
+        idx = np.fromiter((self.subset[int(i)] for i in indices), np.int64,
+                          count=len(indices))
+        return np.stack(
+            [self._counts["x"][idx], self._counts["senders"][idx]], axis=1
+        )
+
     def load_all(self) -> list[GraphSample]:
         return [self[i] for i in range(len(self))]
 
@@ -218,6 +228,29 @@ class PackedDataset:
         """Per-rank shard window (AdiosDataset.setsubset semantics)."""
         self.subset = range(start, stop)
         return self
+
+
+def pad_spec_from_stats(
+    attrs: dict, batch_size: int, node_multiple: int = 8,
+    edge_multiple: int = 128,
+):
+    """PadSpec from writer-recorded ``max_nodes``/``max_edges`` stats — the
+    ONE place the padding formula lives (GlobalShuffleStore and ShardedStore
+    both derive their static shapes here, so they can never diverge)."""
+    from ..graphs.batching import PadSpec
+
+    if "max_nodes" not in attrs:
+        raise ValueError("packed file lacks size stats; re-write with PackedWriter")
+    import math
+
+    def up(v, m):
+        return int(math.ceil(max(v, 1) / m) * m)
+
+    return PadSpec(
+        n_node=up(attrs["max_nodes"] * batch_size + 1, node_multiple),
+        n_edge=up(attrs["max_edges"] * batch_size + 1, edge_multiple),
+        n_graph=batch_size + 1,
+    )
 
 
 class GlobalShuffleStore:
@@ -250,27 +283,17 @@ class GlobalShuffleStore:
     def __getitem__(self, i: int) -> GraphSample:
         return self.ds[int(i)]
 
+    def sample_sizes(self, indices) -> np.ndarray:
+        return self.ds.sample_sizes(indices)
+
     @property
     def attrs(self) -> dict:
         return self.ds.attrs
 
     def pad_spec(self, batch_size: int, node_multiple: int = 8, edge_multiple: int = 128):
         """PadSpec from writer-recorded size stats — no full scan."""
-        from ..graphs.batching import PadSpec
-
-        a = self.attrs
-        if "max_nodes" not in a:
-            raise ValueError("packed file lacks size stats; re-write with PackedWriter")
-        import math
-
-        def up(v, m):
-            return int(math.ceil(max(v, 1) / m) * m)
-
-        return PadSpec(
-            n_node=up(a["max_nodes"] * batch_size + 1, node_multiple),
-            n_edge=up(a["max_edges"] * batch_size + 1, edge_multiple),
-            n_graph=batch_size + 1,
-        )
+        return pad_spec_from_stats(self.attrs, batch_size, node_multiple,
+                                   edge_multiple)
 
     def loader(
         self,

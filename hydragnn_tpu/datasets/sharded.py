@@ -120,10 +120,15 @@ def _decode_samples(payload: bytes) -> list[GraphSample]:
 
 class ShardServer:
     """Threaded TCP server answering batched sample fetches from the local
-    shard. Request: npz {"idx": int64[k]} of LOCAL indices; response: the
-    encoded samples."""
+    shard. Request: npz {"idx": int64[k] LOCAL indices, "range": [start,
+    stop] the GLOBAL range the client believes this server owns}; response:
+    the encoded samples, or an error record when the range doesn't match —
+    a misrouted connection (e.g. every host advertising a loopback address,
+    so peers dial their OWN server) must fail LOUDLY, not silently serve
+    wrong samples."""
 
-    def __init__(self, ds: PackedDataset, host: str = "0.0.0.0"):
+    def __init__(self, ds: PackedDataset, start: int, stop: int,
+                 host: str = "0.0.0.0"):
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -133,6 +138,30 @@ class ShardServer:
                         req = _recv_msg(self.request)
                         with np.load(io.BytesIO(req), allow_pickle=False) as z:
                             idx = z["idx"]
+                            want = z["range"] if "range" in z.files else None
+                        if want is not None and (
+                            int(want[0]) != outer.start or int(want[1]) != outer.stop
+                        ):
+                            buf = io.BytesIO()
+                            np.savez(
+                                buf, n=np.asarray(-1, np.int64),
+                                have=np.asarray([outer.start, outer.stop], np.int64),
+                            )
+                            _send_msg(self.request, buf.getvalue())
+                            continue
+                        if "sizes" in z.files:
+                            # size-table op: (num_nodes, num_edges) for the
+                            # whole shard straight from the count index —
+                            # bucket planning never pulls sample content
+                            buf = io.BytesIO()
+                            np.savez(
+                                buf, n=np.asarray(0, np.int64),
+                                sizes=outer.ds.sample_sizes(
+                                    range(outer.stop - outer.start)
+                                ),
+                            )
+                            _send_msg(self.request, buf.getvalue())
+                            continue
                         samples = [outer.ds[int(i)] for i in idx]
                         _send_msg(self.request, _encode_samples(samples))
                 except (ConnectionError, OSError):
@@ -143,6 +172,7 @@ class ShardServer:
             allow_reuse_address = True
 
         self.ds = ds
+        self.start, self.stop = int(start), int(stop)
         self._srv = Server((host, 0), Handler)
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
@@ -177,7 +207,7 @@ class ShardedStore:
                 f"claims global range [{start}, {stop})"
             )
         self.start, self.stop = int(start), int(stop)
-        self.server = ShardServer(self.ds)
+        self.server = ShardServer(self.ds, start, stop)
         if peers is None:
             peers = self._allgather_peers(advertise_host)
         self.peers = sorted(peers, key=lambda p: p[2])  # by start index
@@ -192,6 +222,7 @@ class ShardedStore:
         self._lock = threading.Lock()
         self._cache: OrderedDict[int, GraphSample] = OrderedDict()
         self._cache_size = int(cache_size)
+        self._sizes: np.ndarray | None = None  # lazy global size table
         self.remote_fetches = 0  # telemetry: audited by tests/bench
 
     def _allgather_peers(self, advertise_host: str | None):
@@ -233,6 +264,38 @@ class ShardedStore:
             return self.ds[i - self.start]
         return self.fetch([i])[0]
 
+    def sample_sizes(self, indices) -> np.ndarray:
+        """[k, 2] (num_nodes, num_edges) for arbitrary GLOBAL indices. The
+        full size table is exchanged ONCE (one request per peer, a few
+        int64s per sample), so bucket planning never turns into per-sample
+        content fetches across the network."""
+        if self._sizes is None:
+            self._sizes = self._fetch_all_sizes()
+        return self._sizes[np.asarray(indices, np.int64)]
+
+    def _fetch_all_sizes(self) -> np.ndarray:
+        out = np.zeros((self.total, 2), np.int64)
+        with self._lock:
+            for rank, (host, port, s0, s1) in enumerate(self.peers):
+                if s0 == self.start and s1 == self.stop:
+                    out[s0:s1] = self.ds.sample_sizes(range(s1 - s0))
+                    continue
+                sock = self._conn(rank, host, port)
+                buf = io.BytesIO()
+                np.savez(buf, idx=np.zeros((0,), np.int64),
+                         range=np.asarray([s0, s1], np.int64),
+                         sizes=np.asarray(1, np.int64))
+                _send_msg(sock, buf.getvalue())
+                with np.load(io.BytesIO(_recv_msg(sock)),
+                             allow_pickle=False) as z:
+                    if int(z["n"]) < 0:
+                        raise RuntimeError(
+                            f"size-table fetch misrouted at {host}:{port} "
+                            f"(expected range [{s0}, {s1}))"
+                        )
+                    out[s0:s1] = z["sizes"]
+        return out
+
     def fetch(self, indices) -> list[GraphSample]:
         """Batched read of arbitrary GLOBAL indices: local ones from mmap,
         remote ones with ONE request per owning host."""
@@ -249,12 +312,24 @@ class ShardedStore:
                     rank = self._owner(i)[0]
                     by_owner.setdefault(rank, []).append(i)
             for rank, idxs in by_owner.items():
-                host, port, s0 = self.peers[rank][0], self.peers[rank][1], self.peers[rank][2]
+                host, port, s0, s1 = self.peers[rank]
                 sock = self._conn(rank, host, port)
                 buf = io.BytesIO()
-                np.savez(buf, idx=np.asarray([i - s0 for i in idxs], np.int64))
+                np.savez(buf, idx=np.asarray([i - s0 for i in idxs], np.int64),
+                         range=np.asarray([s0, s1], np.int64))
                 _send_msg(sock, buf.getvalue())
-                samples = _decode_samples(_recv_msg(sock))
+                payload = _recv_msg(sock)
+                with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+                    if int(z["n"]) < 0:
+                        have = z["have"] if "have" in z.files else "?"
+                        raise RuntimeError(
+                            f"shard fetch misrouted: peer at {host}:{port} "
+                            f"owns global range {have}, expected [{s0}, {s1})"
+                            " — check the advertised addresses (loopback "
+                            "hostnames on multi-host clusters are the usual "
+                            "cause; pass advertise_host explicitly)"
+                        )
+                samples = _decode_samples(payload)
                 self.remote_fetches += len(samples)
                 for i, s in zip(idxs, samples):
                     out[i] = s
@@ -270,32 +345,27 @@ class ShardedStore:
         if "max_nodes" not in a:
             raise ValueError("packed shard lacks size stats; re-write with PackedWriter")
         try:
-            from jax.experimental import multihost_utils
-
             import jax
 
-            if jax.process_count() > 1:
-                stats = np.asarray(
-                    multihost_utils.process_allgather(
-                        np.array([a["max_nodes"], a["max_edges"]], np.int64)
-                    )
-                )
-                a["max_nodes"] = int(stats[:, 0].max())
-                a["max_edges"] = int(stats[:, 1].max())
+            multi = jax.process_count() > 1
         except Exception:
-            pass
-        import math
+            multi = False
+        if multi:
+            # MUST succeed: silently falling back to shard-local maxima
+            # would give hosts different static shapes and hang/crash the
+            # SPMD program far from the root cause
+            from jax.experimental import multihost_utils
 
-        from ..graphs.batching import PadSpec
+            stats = np.asarray(
+                multihost_utils.process_allgather(
+                    np.array([a["max_nodes"], a["max_edges"]], np.int64)
+                )
+            )
+            a["max_nodes"] = int(stats[:, 0].max())
+            a["max_edges"] = int(stats[:, 1].max())
+        from .packed import pad_spec_from_stats
 
-        def up(v, m):
-            return int(math.ceil(max(v, 1) / m) * m)
-
-        return PadSpec(
-            n_node=up(a["max_nodes"] * batch_size + 1, node_multiple),
-            n_edge=up(a["max_edges"] * batch_size + 1, edge_multiple),
-            n_graph=batch_size + 1,
-        )
+        return pad_spec_from_stats(a, batch_size, node_multiple, edge_multiple)
 
     def loader(
         self,

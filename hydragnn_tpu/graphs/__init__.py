@@ -1,9 +1,10 @@
-from .graph import GraphBatch, GraphSample
+from .graph import GraphBatch, GraphSample, SegHintStats
 from .batching import PadSpec, collate, compute_pad_spec, GraphLoader
 from .radius import radius_graph, build_radius_graph
 from . import segment
 
 __all__ = [
+    "SegHintStats",
     "GraphBatch",
     "GraphSample",
     "PadSpec",

@@ -262,14 +262,22 @@ def _batch_meta(
         bound = node_cap
     else:
         bound = pow2
+    # exempt_pad_id: collate reserves node N-1 (and graph G-1) as the masked
+    # zero-contribution slot, so trailing pad edges wired there must not veto
+    # certification — see window_fits_host for the soundness argument
     return BatchMeta(
         gs_fits=(
-            window_fits_host(senders, N, GS_CERT_WINDOW, GS_CERT_BLOCK)
-            and window_fits_host(receivers, N, GS_CERT_WINDOW, GS_CERT_BLOCK)
+            window_fits_host(senders, N, GS_CERT_WINDOW, GS_CERT_BLOCK,
+                             exempt_pad_id=True)
+            and window_fits_host(receivers, N, GS_CERT_WINDOW, GS_CERT_BLOCK,
+                                 exempt_pad_id=True)
         ),
-        recv_fits=window_fits_host(receivers, N, segment_window(N), 256),
-        send_fits=window_fits_host(senders, N, segment_window(N), 256),
-        pool_fits=window_fits_host(batch, G, segment_window(G), 256),
+        recv_fits=window_fits_host(receivers, N, segment_window(N), 256,
+                                   exempt_pad_id=True),
+        send_fits=window_fits_host(senders, N, segment_window(N), 256,
+                                   exempt_pad_id=True),
+        pool_fits=window_fits_host(batch, G, segment_window(G), 256,
+                                   exempt_pad_id=True),
         max_n_node=bound,
     )
 
@@ -396,6 +404,12 @@ class GraphLoader:
         verdict missing #3 / weak #5)."""
         self.group = max(1, int(n))
 
+    def _pick_bucket_totals(self, tot_n: int, tot_e: int, tot_t: int) -> PadSpec:
+        for b in self.buckets:
+            if tot_n < b.n_node and tot_e <= b.n_edge and tot_t <= b.n_triplet:
+                return b
+        return self.buckets[-1]
+
     def _pick_bucket(self, chunk: Sequence[GraphSample]) -> PadSpec:
         if not self.buckets:
             return self.pad
@@ -404,10 +418,41 @@ class GraphLoader:
         tot_t = sum(
             s.extras["idx_kj"].shape[0] for s in chunk if "idx_kj" in s.extras
         )
-        for b in self.buckets:
-            if tot_n < b.n_node and tot_e <= b.n_edge and tot_t <= b.n_triplet:
+        return self._pick_bucket_totals(tot_n, tot_e, tot_t)
+
+    def _pick_bucket_indices(self, chunk) -> PadSpec:
+        """Bucket choice from sample INDICES: lazy stores exposing
+        ``sample_sizes`` (packed / sharded) answer from their count index —
+        plan-time bucketing never materializes content (over a network
+        store that would be one fetch per sample per epoch)."""
+        if not self.buckets:
+            return self.pad
+        if hasattr(self.samples, "sample_sizes"):
+            sz = self.samples.sample_sizes(chunk)
+            return self._pick_bucket_totals(
+                int(sz[:, 0].sum()), int(sz[:, 1].sum()), 0
+            )
+        return self._pick_bucket([self.samples[i] for i in chunk])
+
+    def _max_spec(self, members: "list[PadSpec]") -> PadSpec:
+        """Component-wise max over specs — correct even for NON-nested
+        bucket lists a caller supplies (a lexicographic max could pick a
+        spec that underfits another member's edge count). Reuses an existing
+        bucket when one dominates, keeping compile count bounded."""
+        if all(m is members[0] for m in members):
+            return members[0]
+        pad = PadSpec(
+            n_node=max(m.n_node for m in members),
+            n_edge=max(m.n_edge for m in members),
+            n_graph=max(m.n_graph for m in members),
+            n_triplet=max(m.n_triplet for m in members),
+            node_cap=members[0].node_cap,
+            attn_cap=members[0].attn_cap,
+        )
+        for b in self.buckets or ():
+            if b.as_tuple() == pad.as_tuple():
                 return b
-        return self.buckets[-1]
+        return pad
 
     def _step_bucket(self, step: int, perm: np.ndarray) -> PadSpec:
         """Bucket for global step ``step``: the smallest bucket that fits
@@ -419,10 +464,8 @@ class GraphLoader:
             chunk = perm[r :: self.world][
                 step * self.batch_size : (step + 1) * self.batch_size
             ]
-            picks.append(self._pick_bucket([self.samples[i] for i in chunk]))
-        # buckets are component-wise nested (quantile levels), so the largest
-        # per-rank pick fits every rank's batch
-        return max(picks, key=lambda p: p.as_tuple())
+            picks.append(self._pick_bucket_indices(chunk))
+        return self._max_spec(picks)
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)
@@ -467,10 +510,15 @@ class GraphLoader:
             chunk = idx[b * self.batch_size : (b + 1) * self.batch_size]
             if len(chunk) == 0:
                 break
-            if self.world > 1:
+            if not self.buckets:
+                # single-bucket loaders must not touch sample CONTENT at
+                # plan time — with a lazy remote store (ShardedStore) that
+                # would cost one fetch per sample per epoch for nothing
+                pad = self.pad
+            elif self.world > 1:
                 pad = self._step_bucket(b, perm)
             else:
-                pad = self._pick_bucket([self.samples[i] for i in chunk])
+                pad = self._pick_bucket_indices(chunk)
             plan.append((chunk, pad))
         if self.group > 1 and self.buckets:
             # device-group streaming: every group of ``group`` consecutive
@@ -481,25 +529,7 @@ class GraphLoader:
             # choice stays SPMD shape-aligned too.
             for i in range(0, len(plan), self.group):
                 members = [p for _, p in plan[i : i + self.group]]
-                # component-wise max: correct even for NON-nested bucket
-                # lists a caller supplies (a lexicographic max could pick a
-                # spec that underfits another member's edge count)
-                pad = members[0]
-                if any(m is not members[0] for m in members):
-                    pad = PadSpec(
-                        n_node=max(m.n_node for m in members),
-                        n_edge=max(m.n_edge for m in members),
-                        n_graph=max(m.n_graph for m in members),
-                        n_triplet=max(m.n_triplet for m in members),
-                        node_cap=members[0].node_cap,
-                        attn_cap=members[0].attn_cap,
-                    )
-                    # reuse an existing bucket when one already dominates —
-                    # keeps the compile count bounded by the table size
-                    for b in self.buckets:
-                        if b.as_tuple() == pad.as_tuple():
-                            pad = b
-                            break
+                pad = self._max_spec(members)
                 for j in range(i, i + len(members)):
                     plan[j] = (plan[j][0], pad)
         return plan

@@ -180,17 +180,49 @@ class GraphBatch(NamedTuple):
         """Static window-fit hint for a segment reduction keyed by WHICH id
         array it uses — matched by object identity, which is stable for
         attribute reads off this NamedTuple (including tracers inside jit).
-        Returns None (→ dynamic fallback) for unknown id arrays."""
+        Returns None (→ dynamic fallback) for unknown id arrays.
+
+        Identity matching silently loses certification for transformed
+        copies (``jnp.asarray``, re-indexed edges); ``SegHintStats`` counts
+        trace-time certified-vs-dynamic resolutions so a regression that
+        re-enters the dynamic path is visible (round-3 advisor note)."""
         m = self.meta
         if m is None:
+            SegHintStats.dynamic += 1
             return None
         if segment_ids is self.receivers:
-            return m.recv_fits
-        if segment_ids is self.senders:
-            return m.send_fits
-        if segment_ids is self.batch:
-            return m.pool_fits
-        return None
+            hint = m.recv_fits
+        elif segment_ids is self.senders:
+            hint = m.send_fits
+        elif segment_ids is self.batch:
+            hint = m.pool_fits
+        else:
+            hint = None
+        if hint is None:
+            SegHintStats.dynamic += 1
+        else:
+            SegHintStats.certified += 1
+        return hint
+
+
+class SegHintStats:
+    """Trace-time audit of layout-certificate hits: how many segment
+    reductions resolved a static certificate vs fell back to the dynamic
+    in-program check. Counters tick at TRACE time (cached executions don't
+    re-count), so after a warmup epoch ``dynamic`` staying at its baseline
+    proves no caller silently lost certification."""
+
+    certified = 0
+    dynamic = 0
+
+    @classmethod
+    def reset(cls) -> None:
+        cls.certified = 0
+        cls.dynamic = 0
+
+    @classmethod
+    def snapshot(cls) -> dict:
+        return {"certified": cls.certified, "dynamic": cls.dynamic}
 
 
 # Data fields (leaves) vs static metadata (aux): explicit registration takes

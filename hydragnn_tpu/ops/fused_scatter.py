@@ -215,7 +215,8 @@ def segment_window(num_segments: int) -> int:
 
 
 def window_fits_host(
-    ids: np.ndarray, num_nodes: int, window: int, block_edges: int
+    ids: np.ndarray, num_nodes: int, window: int, block_edges: int,
+    exempt_pad_id: bool = False,
 ) -> bool:
     """Host (numpy) replica of the kernel's per-block window-fit check, with
     the same pad-to-``block_edges`` convention ``fused_gather_scatter`` /
@@ -223,7 +224,17 @@ def window_fits_host(
     contract STATICALLY (``BatchMeta``), so the in-program ``lax.cond``
     fallback — which ``vmap`` would turn into executing both branches —
     never enters the traced program. Kept adjacent to ``_window_starts`` so
-    the two stay in lockstep (tests assert they agree)."""
+    the two stay in lockstep (tests assert they agree).
+
+    ``exempt_pad_id``: ignore ids equal to ``num_nodes - 1`` — collate's
+    reserved zero-contribution slot (pad edges carry mask weight 0; pad
+    nodes feed the masked dummy graph). Without the exemption, the ONE
+    boundary block mixing real edges with trailing pad edges always spans
+    the whole array and vetoes certification for every production-size
+    batch. Soundness: an out-of-window id matches no lane in the kernel's
+    one-hot comparison, so its edge contributes exactly 0 on that side —
+    identical to the XLA fallback everywhere except possibly the reserved
+    dummy row itself, which collate guarantees is never read unmasked."""
     ids = np.asarray(ids, np.int64)
     e = ids.shape[0]
     if e == 0:
@@ -232,6 +243,15 @@ def window_fits_host(
     if e_pad:
         ids = np.concatenate([ids, np.full(e_pad, num_nodes - 1, np.int64)])
     blocks = ids.reshape(-1, block_edges)
+    if exempt_pad_id:
+        real = blocks != num_nodes - 1
+        if not real.any():
+            return True
+        lo = np.where(real, blocks, np.int64(num_nodes)).min(axis=1)
+        hi = np.where(real, blocks, np.int64(-1)).max(axis=1)
+        has_real = real.any(axis=1)
+        start = np.clip((lo // 8) * 8, 0, max(num_nodes - window, 0))
+        return bool(np.all(~has_real | (hi - start < window)))
     lo = blocks.min(axis=1)
     hi = blocks.max(axis=1)
     start = np.clip((lo // 8) * 8, 0, max(num_nodes - window, 0))

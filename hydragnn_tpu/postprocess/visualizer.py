@@ -303,6 +303,49 @@ class Visualizer:
         plt.close(fig)
         return out
 
+    def create_parity_plot_and_error_histogram_scalar(
+        self, varname: str, true_values, predicted_values, iepoch=None,
+        save_plot: bool = True, contour: bool = False,
+    ) -> str | None:
+        """Scalar-head parity scatter (identity line, equal axes) + error
+        PDF, one file per epoch (reference
+        ``create_parity_plot_and_error_histogram_scalar``,
+        visualizer.py:281-385). ``contour=True`` renders the parity panel as
+        the reference's normalized hist2d CONTOUR instead of a scatter (its
+        ``__hist2d_contour``, :83-92) — the readable form at GFM counts."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        t = np.asarray(true_values).ravel()
+        p = np.asarray(predicted_values).ravel()
+        fig, (ax0, ax1) = plt.subplots(1, 2, figsize=(10, 4.5))
+        if contour and len(t) > 1:
+            h, xe, ye = np.histogram2d(t, p, bins=50)
+            xc = 0.5 * (xe[:-1] + xe[1:])
+            yc = 0.5 * (ye[:-1] + ye[1:])
+            gy, gx = np.meshgrid(yc, xc)
+            ax0.contourf(gx, gy, h / max(h.max(), 1), levels=12)
+        else:
+            ax0.scatter(t, p, s=8, edgecolor="b", facecolor="none")
+        lo = min(t.min(), p.min()) if len(t) else 0.0
+        hi = max(t.max(), p.max()) if len(t) else 1.0
+        ax0.plot([lo, hi], [lo, hi], "r--", lw=1)
+        ax0.set_aspect("equal", adjustable="box")
+        ax0.set_title(f"{varname}, number of samples = {len(t)}")
+        ax0.set_xlabel("True")
+        ax0.set_ylabel("Predicted")
+        hist1d, edges = np.histogram(p - t, bins=40, density=True)
+        ax1.plot(0.5 * (edges[:-1] + edges[1:]), hist1d, "ro")
+        ax1.set_title(f"{varname}: error PDF")
+        suffix = f"_{iepoch}" if iepoch is not None else ""
+        out = os.path.join(self.dir, f"parity_scalar_{varname}{suffix}.png")
+        if save_plot:
+            fig.savefig(out, dpi=120, bbox_inches="tight")
+        plt.close(fig)
+        return out if save_plot else None
+
     # reference-name alias (``create_scatter_plots``, visualizer.py:692)
     def create_scatter_plots(self, true_values, predicted_values, output_names=None):
         return self.create_parity_plot(true_values, predicted_values, names=output_names)

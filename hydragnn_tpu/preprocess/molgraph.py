@@ -230,6 +230,17 @@ _DEFAULT_VALENCE = {5: 3, 6: 4, 7: 3, 8: 2, 9: 1, 15: 3, 16: 2, 17: 1,
                     35: 1, 53: 1}
 
 
+def _charged_valence(z: int, q: int) -> int:
+    """Bonding capacity of a charged atom. For N/P/O/S the charge shifts the
+    valence by q in BOTH directions ([NH4+]: 4, [NH2-]: 2, [OH3+]: 3,
+    [OH-]: 1); for other elements a charge costs a bond either way
+    ([CH3+]/[CH3-]: 3)."""
+    base = _DEFAULT_VALENCE.get(z, 4)
+    if z in (7, 15, 8, 16):
+        return base + q
+    return base - abs(q)
+
+
 def parse_smiles(s: str) -> Mol:
     """Minimal SMILES reader: organic-subset + bracket atoms, branches, ring
     closures (digits and %nn), -/=/#/: bonds, aromatic lowercase. Aromatic
@@ -352,12 +363,9 @@ def _finalize_smiles_mol(atoms: list[dict], bonds: list[list[int]]) -> Mol:
         val = sum(
             bo[(min(i, j), max(i, j))] for j in adj[i]
         ) + (declared_h or 0)
-        target = _DEFAULT_VALENCE.get(zi, 4) + atoms[i]["q"] * (
-            1 if zi in (7, 15) else -1 if zi in (8, 16) else 0
-        )
-        if zi == 7 and declared_h is None and len(adj[i]) == 2:
-            # pyridine-type N takes the pi bond; pyrrole-type ([nH]) doesn't
-            return val < target
+        target = _charged_valence(zi, atoms[i]["q"])
+        # pyridine-type N (no declared H) ends below target and takes the pi
+        # bond; pyrrole-type [nH]'s declared H fills the valence via ``val``
         return val < target
 
     match: dict[int, int] = {}
@@ -390,8 +398,7 @@ def _finalize_smiles_mol(atoms: list[dict], bonds: list[list[int]]) -> Mol:
             n_h[i] = atoms[i]["h"]
             continue
         val = sum(bo[(min(i, j), max(i, j))] for j in adj[i])
-        default = _DEFAULT_VALENCE.get(int(z[i]), 4)
-        n_h[i] = max(default + (q[i] if int(z[i]) in (7, 15) else -abs(q[i])) - val, 0)
+        n_h[i] = max(_charged_valence(int(z[i]), int(q[i])) - val, 0)
     bond_list = [(a, b, o) for (a, b), o in sorted(bo.items())]
     return Mol(z, None, bond_list, q, aromatic=arom, n_hydrogens=n_h)
 

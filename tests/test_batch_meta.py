@@ -244,3 +244,76 @@ def test_gs_certificate_dropped_for_non_default_geometry():
         np.asarray(run(128)),
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_seg_hint_stats_audit_certified_vs_dynamic():
+    """SegHintStats: attribute reads off the batch resolve certificates;
+    transformed copies (jnp.asarray) silently lose them — the counter makes
+    that visible (round-3 advisor weak #8)."""
+    from hydragnn_tpu.graphs import SegHintStats
+
+    samples = _random_samples(4, seed=8)
+    pad = compute_pad_spec(samples, 4)
+    b = collate(samples, pad)
+    SegHintStats.reset()
+    assert b.seg_hint(b.receivers) is not None
+    assert b.seg_hint(b.senders) is not None
+    assert SegHintStats.snapshot() == {"certified": 2, "dynamic": 0}
+    # a transformed copy is NOT identity-matched -> dynamic
+    copy = jnp.asarray(np.asarray(b.receivers))
+    assert b.seg_hint(copy) is None
+    assert SegHintStats.snapshot()["dynamic"] == 1
+
+
+def test_production_size_batch_certifies_with_pad_exemption():
+    """Round-4 finding: the ONE boundary block mixing real and trailing pad
+    edges (wired to the reserved node N-1) used to veto certification for
+    every production-size batch — the static kernel path silently never
+    engaged where it matters. The certificate now exempts the reserved
+    zero-contribution pad id; soundness = an out-of-window id matches no
+    lane in the kernel's one-hot, contributing exactly 0 like the masked
+    fallback. This test pins (a) certification at production size and (b)
+    EXACT fwd+bwd kernel parity on such a batch."""
+    samples = _random_samples(128, seed=11, lo=9, hi=30)
+    pad = compute_pad_spec(samples, 128)
+    b = collate(samples, pad)
+    assert b.meta.gs_fits is True
+    assert b.meta.recv_fits is True and b.meta.send_fits is True
+
+    n = b.x.shape[0]
+    assert n > 512  # genuinely production-shaped, not the tiny-N trivial fit
+    rng = np.random.default_rng(0)
+    h = jnp.asarray(rng.normal(size=(n, 32)), jnp.float32)
+    w = jnp.asarray(np.asarray(b.edge_mask), jnp.float32)
+
+    out_f = fused_scatter.fused_gather_scatter(
+        h, b.senders, b.receivers, n, w, fits=True, interpret=True
+    )
+    out_r = fused_scatter.reference_gather_scatter(
+        h, b.senders, b.receivers, n, w
+    )
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                               rtol=1e-5, atol=1e-5)
+
+    f = lambda x: fused_scatter.fused_gather_scatter(
+        x, b.senders, b.receivers, n, w, fits=True, interpret=True
+    ).sum()
+    g = lambda x: fused_scatter.reference_gather_scatter(
+        x, b.senders, b.receivers, n, w
+    ).sum()
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(f)(h)), np.asarray(jax.grad(g)(h)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_pad_exemption_requires_reserved_slot_semantics():
+    """The exemption is collate-only: the DEFAULT window_fits_host (what the
+    in-program dynamic check mirrors) still rejects layouts whose boundary
+    block spans the array — arbitrary callers with a REAL node at id N-1
+    keep the conservative check."""
+    # one MIXED block: 192 consecutive real ids + 64 trailing pad ids
+    ids = np.concatenate([np.arange(192), np.full(64, 1023)])
+    assert not fused_scatter.window_fits_host(ids, 1024, 256, 256)
+    assert fused_scatter.window_fits_host(ids, 1024, 256, 256,
+                                          exempt_pad_id=True)

@@ -348,3 +348,49 @@ def test_sharded_store_serves_remote_samples(tmp_path):
     finally:
         s0.close()
         s1.close()
+
+
+def test_sharded_store_size_table_and_misroute_guard(tmp_path):
+    """Round-4 review findings: (a) sample_sizes answers from the exchanged
+    size table — zero content fetches for bucket planning; (b) a misrouted
+    connection (peer owning a different global range) fails LOUDLY instead
+    of silently serving wrong samples."""
+    import numpy as np
+    import pytest
+
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+
+    samples = deterministic_graph_data(number_configurations=16, seed=7)
+    p0, p1 = str(tmp_path / "a.gpk"), str(tmp_path / "b.gpk")
+    PackedWriter(samples[:10], p0)
+    PackedWriter(samples[10:], p1)
+    s0 = ShardedStore(p0, 0, 10, peers=[("127.0.0.1", 0, 0, 10)])
+    s1 = ShardedStore(
+        p1, 10, 16,
+        peers=[("127.0.0.1", s0.server.port, 0, 10), ("127.0.0.1", 0, 10, 16)],
+    )
+    s0.peers = [("127.0.0.1", s0.server.port, 0, 10),
+                ("127.0.0.1", s1.server.port, 10, 16)]
+    s0.total = s1.total = 16
+    try:
+        sz = s0.sample_sizes(range(16))
+        assert sz.shape == (16, 2)
+        for i in (0, 9, 10, 15):
+            assert sz[i, 0] == samples[i].num_nodes
+            assert sz[i, 1] == samples[i].num_edges
+        assert s0.remote_fetches == 0  # size table cost no content fetch
+
+        # misroute: point s0's second peer at s0's OWN server (the loopback
+        # failure mode) — the range handshake must raise, not serve sample 0
+        s_bad = ShardedStore(p0, 0, 10, peers=[("127.0.0.1", 0, 0, 10)])
+        s_bad.peers = [("127.0.0.1", s_bad.server.port, 0, 10),
+                       ("127.0.0.1", s_bad.server.port, 10, 16)]
+        s_bad.total = 16
+        with pytest.raises(RuntimeError, match="misrouted"):
+            s_bad[12]
+        s_bad.close()
+    finally:
+        s0.close()
+        s1.close()

@@ -243,3 +243,23 @@ def test_subprocess_objective_crash_and_timeout_score_inf(tmp_path):
     assert obj3({"a": 5}) == 10.0
     recs = sorted((tmp_path / "k2").glob("trial_*.json"))
     assert len(recs) == 2  # one record per trial of THIS evaluator
+
+
+def test_visualizer_scalar_parity_and_contour(tmp_path):
+    """Reference create_parity_plot_and_error_histogram_scalar incl. the
+    hist2d-contour form (visualizer.py:83-92,281-385)."""
+    import os
+
+    rng = np.random.default_rng(0)
+    t = rng.normal(size=400)
+    p = t + rng.normal(scale=0.1, size=400)
+    viz = Visualizer("viz_scalar", path=str(tmp_path))
+    out = viz.create_parity_plot_and_error_histogram_scalar("energy", t, p, iepoch=3)
+    assert out and os.path.exists(out) and "energy_3" in out
+    out2 = viz.create_parity_plot_and_error_histogram_scalar(
+        "energy", t, p, contour=True
+    )
+    assert out2 and os.path.exists(out2)
+    assert viz.create_parity_plot_and_error_histogram_scalar(
+        "energy", t, p, save_plot=False
+    ) is None
