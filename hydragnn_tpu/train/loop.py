@@ -99,6 +99,45 @@ def _local_device_count(mesh) -> int:
     return len(mesh.local_devices)
 
 
+# Per-step metrics stay ON DEVICE while the loop runs — a float() per step
+# would block the host on every result, serializing dispatch (the reference's
+# torch loop likewise calls .item() only on epoch aggregates,
+# train_validate_test.py:795-799). The window bounds how far the host may run
+# ahead, so queued steps' input batches can't accumulate without limit in
+# device memory on backends with deep execution queues.
+_MAX_IN_FLIGHT = 32
+
+
+def _backpressure(step_metrics: list) -> None:
+    if len(step_metrics) > _MAX_IN_FLIGHT:
+        jax.block_until_ready(step_metrics[-_MAX_IN_FLIGHT - 1]["loss"])
+
+
+def _accumulate(step_metrics: list, extra_keys: tuple = ()):
+    """Graph-count-weighted reduction of an epoch's metrics — ONE batched
+    device-to-host fetch for everything, then pure numpy."""
+    step_metrics = jax.device_get(step_metrics)
+    tot = 0.0
+    tasks = None
+    n_graphs = 0.0
+    extras = {k: None for k in extra_keys}
+    for m in step_metrics:
+        g = float(m["num_graphs"])
+        tot += float(m["loss"]) * g
+        t = np.asarray(m["tasks_loss"], np.float64) * g
+        tasks = t if tasks is None else tasks + t
+        for k in extra_keys:
+            v = np.asarray(m[k], np.float64)
+            extras[k] = v if extras[k] is None else extras[k] + v
+        n_graphs += g
+    denom = max(n_graphs, 1.0)
+    return (
+        tot / denom,
+        (tasks / denom if tasks is not None else np.zeros(0)),
+        extras,
+    )
+
+
 def train_epoch(
     train_step, state: TrainState, loader, verbosity: int = 0, mesh=None,
     put_fn=None, group_n=None, group_put=None,
@@ -108,9 +147,6 @@ def train_epoch(
     grouping; every step consumes ONE batch sharded across the mesh.
     ``group_n``/``group_put`` override the grouped path's stack size and
     placement (pipeline mode: n_micro microbatches, replicated)."""
-    tot = 0.0
-    tasks = None
-    n_graphs = 0.0
     nbatch = _max_num_batches(loader)
     grouped = mesh is not None and put_fn is None
     n_dev = (group_n or _local_device_count(mesh)) if grouped else 1
@@ -123,6 +159,7 @@ def train_epoch(
         if grouped
         else iterate_tqdm(loader, verbosity, desc="train", total=nbatch)
     )
+    step_metrics = []  # on-device until the epoch ends (see _MAX_IN_FLIGHT)
     tr.start("train")
     for ib, batch in enumerate(it):
         if ib >= nbatch:
@@ -132,15 +169,13 @@ def train_epoch(
         elif mesh is None:
             batch = jax.tree.map(jnp.asarray, batch)
         state, metrics = train_step(state, batch)
-        # loss accumulated weighted by real graph count (reference :795-799)
-        g = float(metrics["num_graphs"])
-        tot += float(metrics["loss"]) * g
-        t = np.asarray(metrics["tasks_loss"], np.float64) * g
-        tasks = t if tasks is None else tasks + t
-        n_graphs += g
+        step_metrics.append(metrics)
+        _backpressure(step_metrics)
+    if step_metrics:  # keep the device wait inside the train span
+        jax.block_until_ready(step_metrics[-1]["loss"])
     tr.stop("train")
-    denom = max(n_graphs, 1.0)
-    return state, tot / denom, (tasks / denom if tasks is not None else np.zeros(0))
+    loss, tasks, _ = _accumulate(step_metrics)
+    return state, loss, tasks
 
 
 def evaluate(
@@ -148,11 +183,6 @@ def evaluate(
     mesh=None, put_fn=None, group_n=None, group_put=None,
 ):
     """Full-split evaluation; returns (loss, per-task losses, per-head rmse)."""
-    tot = 0.0
-    tasks = None
-    sse = None
-    count = None
-    n_graphs = 0.0
     grouped = mesh is not None and put_fn is None
     n_dev = (group_n or _local_device_count(mesh)) if grouped else 1
     it = (
@@ -160,32 +190,26 @@ def evaluate(
         if grouped
         else iterate_tqdm(loader, verbosity, desc=span, total=len(loader))
     )
+    step_metrics = []  # on-device until the split finishes (see train_epoch)
     tr.start(span)
     for batch in it:
         if put_fn is not None:
             batch = put_fn(batch)
         elif mesh is None:
             batch = jax.tree.map(jnp.asarray, batch)
-        metrics = eval_step(state, batch)
-        g = float(metrics["num_graphs"])
-        tot += float(metrics["loss"]) * g
-        t = np.asarray(metrics["tasks_loss"], np.float64) * g
-        s = np.asarray(metrics["head_sse"], np.float64)
-        c = np.asarray(metrics["head_count"], np.float64)
-        tasks = t if tasks is None else tasks + t
-        sse = s if sse is None else sse + s
-        count = c if count is None else count + c
-        n_graphs += g
+        step_metrics.append(eval_step(state, batch))
+        _backpressure(step_metrics)
+    if step_metrics:
+        jax.block_until_ready(step_metrics[-1]["loss"])
     tr.stop(span)
-    denom = max(n_graphs, 1.0)
+    loss, tasks, extras = _accumulate(
+        step_metrics, extra_keys=("head_sse", "head_count")
+    )
+    sse, count = extras["head_sse"], extras["head_count"]
     rmse = (
         np.sqrt(sse / np.maximum(count, 1.0)) if sse is not None else np.zeros(0)
     )
-    return (
-        tot / denom,
-        (tasks / denom if tasks is not None else np.zeros(0)),
-        rmse,
-    )
+    return loss, tasks, rmse
 
 
 def train_validate_test(
