@@ -274,6 +274,7 @@ def bench_loader(batch_size: int) -> dict:
 
     def run(buckets):
         loader = GraphLoader(samples, batch_size, shuffle=True, buckets=buckets)
+        next(iter(loader))  # warm allocator/imports so both rows compare
         t0 = time.perf_counter()
         bs = list(loader)
         dt = time.perf_counter() - t0
