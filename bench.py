@@ -263,6 +263,32 @@ def _run_workload(
     return rec
 
 
+def bench_inference(batch_size: int, bench_steps: int, warmup: int) -> dict:
+    """Inference throughput on the flagship model (the reference's SC26
+    fused-inference benchmark role): jitted eval step, bf16, graphs/sec."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.train import make_eval_step
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 64
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    samples = make_qm9_like_samples(max(batch_size * 4, 512), seed=7)
+
+    def make_step(model, optimizer):
+        import jax
+
+        eval_step = make_eval_step(model, compute_dtype=jnp.bfloat16)
+        # jitted wrapper so the shared protocol's cost analysis (MFU) works
+        return jax.jit(lambda state, batch: (state, eval_step(state, batch)))
+
+    return _run_workload(
+        "inference_gin", cfg, samples, make_step, "bf16", batch_size,
+        bench_steps, warmup,
+    )
+
+
 def bench_loader(batch_size: int) -> dict:
     """Host input-pipeline row (round-3 verdict #9): collate throughput and
     the padding-waste ratio, worst-case bucket vs the quantile bucket table
@@ -561,6 +587,11 @@ def child_main(status_path: str) -> None:
         plan.append(("fused_ab", fused_ab))
     if os.getenv("BENCH_PALLAS_VALIDATE", "1") != "0":
         plan.append(("pallas_validate", bench_pallas_validate))
+    # newest row LAST so budget pressure skips it before the rows earlier
+    # rounds already report (row continuity)
+    plan.append(
+        ("inference", lambda: bench_inference(batch_size, bench_steps, warmup))
+    )
 
     done: set = set()
     for name, fn in plan:
