@@ -27,3 +27,28 @@ assert not jax._src.xla_bridge._backends, "jax backends initialized before conft
 # virtual devices per step cuts optimizer updates 8x for the same epochs
 # (standard large-batch scaling). Tests opt into auto-parallel explicitly.
 os.environ.setdefault("HYDRAGNN_AUTO_PARALLEL", "0")
+
+
+def random_molecule_samples(n, seed=0, lo=9, hi=30):
+    """Canonical random-radius-graph test samples (QM9-ish sizes), shared by
+    the kernel/certificate test files."""
+    import numpy as _np
+
+    from hydragnn_tpu.graphs.graph import GraphSample
+    from hydragnn_tpu.graphs.radius import radius_graph
+
+    rng = _np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        na = int(rng.integers(lo, hi))
+        pos = rng.uniform(0, 6.0, size=(na, 3))
+        s, r, sh = radius_graph(pos, radius=3.0, max_neighbours=20)
+        out.append(
+            GraphSample(
+                x=rng.normal(size=(na, 1)).astype(_np.float32),
+                pos=pos, senders=s, receivers=r, edge_shifts=sh,
+                graph_y=rng.normal(size=(1,)),
+                node_y=rng.normal(size=(na, 1)),
+            )
+        )
+    return out
