@@ -1,0 +1,239 @@
+"""On-device molecular dynamics with MLIP models.
+
+The reference's neighbor search (vesin, ``graph_samples_checks_and_updates
+.py:170-176``) is HOST-side: an MD loop driven by its models pays a
+device->host->device round trip per step to rebuild the graph. This module
+keeps the whole MD step on the TPU:
+
+* ``dynamic_radius_graph`` — a jit-able radius graph with STATIC output
+  shapes: the O(N^2) minimum-image distance matrix is one MXU-friendly
+  matmul-shaped op, and the edge list lands in fixed ``[max_edges]`` arrays
+  via ``jnp.nonzero(..., size=...)`` (padded entries masked). For the
+  molecular system sizes MLIP MD runs on-chip (10^2-10^4 atoms), the dense
+  matrix is faster than any host cell list because it never leaves the
+  device; beyond that, shard atoms over the mesh first.
+* ``velocity_verlet`` / ``make_md_step`` — the standard integrator with
+  forces from ``jax.grad`` of any energy function (e.g. an MLIP model's
+  energy head), one ``lax.scan`` per trajectory segment: graph rebuild,
+  force evaluation, and integration all inside a single compiled program.
+
+This exceeds the reference (which has no on-device MD path) while reusing
+its semantics: edges are directed pairs within ``cutoff`` under minimum-
+image PBC, matching ``graphs.radius.radius_graph`` (tested for parity).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def dynamic_radius_graph(
+    pos: Array,
+    cutoff: float,
+    max_edges: int,
+    cell: Array | None = None,
+    pbc: Array | None = None,
+    pad_id: int = 0,
+):
+    """Jit-able directed radius graph with static shapes.
+
+    Returns ``(senders, receivers, shifts, edge_mask, n_edges)``:
+    ``senders``/``receivers`` are ``[max_edges]`` int32 (padded entries
+    point at ``pad_id`` with ``edge_mask`` 0 — pass the batch's reserved
+    dummy-node index when feeding a model, so unmasked mean/count
+    aggregations never see pad edges at a real atom), ``shifts`` the Cartesian
+    minimum-image shift vectors (``pos[r] - pos[s] + shift`` is the edge
+    vector, the ``radius_graph`` convention), and ``n_edges`` the true edge
+    count — callers must check ``n_edges <= max_edges`` (an overflow keeps
+    the nearest-by-index prefix and flags itself via ``n_edges``).
+
+    PBC uses single minimum image per pair (one image per neighbor), valid
+    while ``cutoff < half the smallest cell height`` — the standard MD
+    regime; multi-image edges need the host-side builder."""
+    n = pos.shape[0]
+    disp = pos[None, :, :] - pos[:, None, :]  # [s, r, 3] = pos[r] - pos[s]
+    shift = jnp.zeros_like(disp)
+    if cell is not None:
+        cell = jnp.asarray(cell, pos.dtype).reshape(3, 3)
+        frac = disp @ jnp.linalg.inv(cell)
+        wrap = jnp.round(frac)
+        if pbc is not None:
+            wrap = wrap * jnp.asarray(pbc, pos.dtype).reshape(3)
+        shift = -(wrap @ cell)
+        disp = disp + shift
+    d2 = jnp.sum(disp * disp, axis=-1)
+    within = (d2 <= cutoff * cutoff) & ~jnp.eye(n, dtype=bool)
+    n_edges = within.sum()
+    flat_idx = jnp.nonzero(
+        within.reshape(-1), size=max_edges, fill_value=0
+    )[0]
+    edge_mask = (jnp.arange(max_edges) < n_edges).astype(pos.dtype)
+    senders = (flat_idx // n).astype(jnp.int32)
+    receivers = (flat_idx % n).astype(jnp.int32)
+    shifts = shift[senders, receivers] * edge_mask[:, None]
+    senders = jnp.where(edge_mask > 0, senders, pad_id)
+    receivers = jnp.where(edge_mask > 0, receivers, pad_id)
+    return senders, receivers, shifts, edge_mask, n_edges
+
+
+class MDState(NamedTuple):
+    pos: Array       # [N, 3]
+    vel: Array       # [N, 3]
+    forces: Array    # [N, 3]
+    energy: Array    # scalar potential energy
+    n_edges: Array   # neighbor count of the last rebuild (overflow telltale)
+
+
+def make_md_step(
+    energy_fn: Callable,
+    masses: Array,
+    dt: float,
+    cutoff: float,
+    max_edges: int,
+    cell: Array | None = None,
+    pbc: Array | None = None,
+    pad_id: int = 0,
+):
+    """Velocity-Verlet step with on-device graph rebuild.
+
+    ``energy_fn(pos, senders, receivers, shifts, edge_mask) -> scalar``:
+    wrap an MLIP model's energy head (or an analytic potential). Forces come
+    from ``jax.grad`` of it — the same energy-conserving construction the
+    MLIP training loss uses (``models/mlip.py``). ``pad_id``: where padded
+    edge slots point (a model's reserved dummy-node index)."""
+    m = jnp.asarray(masses).reshape(-1, 1)
+
+    def potential(pos):
+        s, r, sh, em, ne = dynamic_radius_graph(
+            pos, cutoff, max_edges, cell=cell, pbc=pbc, pad_id=pad_id
+        )
+        return energy_fn(pos, s, r, sh, em), ne
+
+    def init(pos, vel) -> MDState:
+        (e, ne), f = jax.value_and_grad(potential, has_aux=True)(pos)
+        return MDState(pos=pos, vel=vel, forces=-f, energy=e, n_edges=ne)
+
+    @jax.jit
+    def step(state: MDState) -> MDState:
+        vel_half = state.vel + 0.5 * dt * state.forces / m
+        pos = state.pos + dt * vel_half
+        if cell is not None and pbc is not None:
+            c = jnp.asarray(cell, pos.dtype).reshape(3, 3)
+            frac = pos @ jnp.linalg.inv(c)
+            frac = jnp.where(
+                jnp.asarray(pbc, bool).reshape(3), frac % 1.0, frac
+            )
+            pos = frac @ c
+        (e, ne), g = jax.value_and_grad(potential, has_aux=True)(pos)
+        forces = -g
+        vel = vel_half + 0.5 * dt * forces / m
+        return MDState(pos=pos, vel=vel, forces=forces, energy=e, n_edges=ne)
+
+    return init, step
+
+
+def run_md(
+    energy_fn: Callable,
+    pos: Array,
+    vel: Array,
+    masses: Array,
+    dt: float,
+    n_steps: int,
+    cutoff: float,
+    max_edges: int,
+    cell: Array | None = None,
+    pbc: Array | None = None,
+    record_every: int = 1,
+    pad_id: int = 0,
+):
+    """Roll a trajectory fully on device: ``lax.scan`` over MD steps, one
+    compiled program. Returns (final_state, stacked recorded MDStates)."""
+    if n_steps % record_every:
+        raise ValueError(
+            f"n_steps={n_steps} must be a multiple of record_every="
+            f"{record_every} (the scan would silently drop the remainder)"
+        )
+    init, step = make_md_step(
+        energy_fn, masses, dt, cutoff, max_edges, cell=cell, pbc=pbc,
+        pad_id=pad_id,
+    )
+    state = init(jnp.asarray(pos), jnp.asarray(vel))
+    n_rec = n_steps // record_every
+
+    @jax.jit
+    def segment(state):
+        def body(s, _):
+            def inner(s2, _):
+                return step(s2), None
+
+            s, _ = jax.lax.scan(inner, s, None, length=record_every)
+            return s, s
+
+        return jax.lax.scan(body, state, None, length=n_rec)
+
+    return segment(state)
+
+
+def mlip_energy_fn(model, variables, template) -> Callable:
+    """Adapt an MLIP model's energy head (``models.mlip``) to the
+    ``dynamic_radius_graph`` edge arrays. ``template`` is a single-graph
+    ``GraphBatch`` collated with the SAME max_edges padding — it supplies
+    the static node features / masks; each call swaps in the current
+    positions and neighbor arrays, so the whole MD step (graph rebuild +
+    model forward + force grad + integration) stays one compiled program.
+
+    Pass ``pad_id = template dummy-node index`` (``n_node - 1``) to the
+    graph rebuild so pad edges follow the batch convention. Models whose
+    forward reads per-edge attributes or angular triplets (DimeNet) are
+    rejected: their edge_attr/idx_kj rows describe the TEMPLATE's topology
+    and would silently go stale as the neighbor list evolves."""
+    from .models.mlip import make_graph_energy_fn
+
+    spec = model.spec
+    if spec.mpnn_type == "DimeNet":
+        raise ValueError(
+            "on-device MD cannot drive DimeNet: its angular triplet indices "
+            "are host-precomputed per topology and would go stale as the "
+            "neighbor list evolves"
+        )
+    if template.edge_attr.shape[-1]:
+        raise ValueError(
+            "template carries per-edge attributes; they describe the "
+            "template's topology, not the evolving neighbor list — use an "
+            "edge_attr-free config for MD"
+        )
+
+    graph_energy = make_graph_energy_fn(model)
+
+    def energy(pos, senders, receivers, shifts, edge_mask):
+        b = template.replace(
+            senders=senders,
+            receivers=receivers,
+            edge_shifts=shifts,
+            edge_mask=edge_mask,
+            # the template's layout certificates were computed for ITS edge
+            # order; the dynamic arrays are sender-major — a stale cert
+            # would statically route the Pallas kernel onto an uncertified
+            # layout (silently wrong sums), so drop to the dynamic check
+            meta=None,
+        )
+        return graph_energy(variables, pos, b).sum()
+
+    return energy
+
+
+def kinetic_energy(vel: Array, masses: Array) -> Array:
+    m = jnp.asarray(masses).reshape(-1, 1)
+    return 0.5 * jnp.sum(m * vel * vel)
+
+
+__all__ = [
+    "MDState", "dynamic_radius_graph", "kinetic_energy", "make_md_step",
+    "mlip_energy_fn", "run_md",
+]
