@@ -1,0 +1,125 @@
+"""Train a small MLIP, then roll molecular dynamics WITH IT fully on-device.
+
+Beyond the reference: its neighbor search (vesin) is host-side, so an MD
+loop driven by a HydraGNN potential pays a device->host->device round trip
+every step. Here ``hydragnn_tpu.md`` rebuilds the radius graph, evaluates
+the model energy, takes ``jax.grad`` forces, and integrates velocity Verlet
+inside ONE compiled program per step — ``lax.scan`` rolls whole trajectory
+segments without the host in the loop.
+
+    python examples/md_rollout/md_rollout.py [--epochs 8] [--steps 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+CONFIG = {
+    "Verbosity": {"level": 0},
+    "Dataset": {
+        "name": "md_rollout",
+        "format": "unit_test",
+        "node_features": {"name": ["type"], "dim": [1], "column_index": [0]},
+        "graph_features": {"name": ["energy"], "dim": [1], "column_index": [0]},
+    },
+    "NeuralNetwork": {
+        "Architecture": {
+            "mpnn_type": "EGNN",
+            "radius": 5.0,
+            "max_neighbours": 100,
+            "hidden_dim": 16,
+            "num_conv_layers": 2,
+            "equivariance": True,
+            "enable_interatomic_potential": True,
+            "graph_pooling": "add",
+            "energy_weight": 1.0,
+            "force_weight": 10.0,
+            "output_heads": {
+                "graph": {
+                    "num_sharedlayers": 1,
+                    "dim_sharedlayers": 8,
+                    "num_headlayers": 1,
+                    "dim_headlayers": [16],
+                }
+            },
+            "task_weights": [1.0],
+        },
+        "Variables_of_interest": {
+            "input_node_features": [0],
+            "output_index": [0],
+            "type": ["graph"],
+            "denormalize_output": False,
+        },
+        "Training": {
+            "num_epoch": 8,
+            "batch_size": 8,
+            "perc_train": 0.8,
+            "loss_function_type": "mse",
+            "Optimizer": {"type": "AdamW", "learning_rate": 2e-3},
+        },
+    },
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--configs", type=int, default=60)
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--dt", type=float, default=1e-3)
+    ap.add_argument("--record-every", type=int, default=20)
+    args = ap.parse_args()
+    CONFIG["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import hydragnn_tpu
+    from hydragnn_tpu.datasets import lennard_jones_data
+    from hydragnn_tpu.graphs.batching import PadSpec, collate
+    from hydragnn_tpu.md import kinetic_energy, mlip_energy_fn, run_md
+
+    # 1) train the potential through the normal entry
+    samples = lennard_jones_data(
+        number_configurations=args.configs, cells_per_dim=2, seed=6
+    )
+    state, model, cfg = hydragnn_tpu.run_training(CONFIG, samples=samples)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+
+    # 2) wrap its energy head for the on-device MD loop
+    n = samples[0].num_nodes
+    max_edges = 4096
+    pad = PadSpec(n_node=n + 8, n_edge=max_edges, n_graph=2)
+    template = jax.tree.map(jnp.asarray, collate(samples[:1], pad))
+    energy = mlip_energy_fn(model, variables, template)
+
+    # 3) roll a trajectory: graph rebuild + forward + grad + Verlet on-chip
+    pos0 = jnp.asarray(samples[0].pos, jnp.float32)
+    vel0 = jnp.zeros((n, 3), jnp.float32)
+    steps = args.steps - args.steps % args.record_every
+    final, traj = run_md(
+        energy, pos0, vel0, jnp.ones((n,)), dt=args.dt, n_steps=steps,
+        cutoff=float(CONFIG["NeuralNetwork"]["Architecture"]["radius"]),
+        max_edges=max_edges, record_every=args.record_every,
+        pad_id=pad.n_node - 1,
+    )
+    pot = np.asarray(traj.energy)
+    kin = np.array([float(kinetic_energy(v, jnp.ones((n,)))) for v in traj.vel])
+    tot = pot + kin
+    assert np.all(np.isfinite(tot)), "trajectory diverged"
+    assert int(final.max_n_edges) <= max_edges, "edge buffer overflow"
+    drift = abs(tot[-1] - tot[0]) / max(abs(tot[0]), 1e-9)
+    print(
+        f"MD rollout: {steps} steps on-device, {n} atoms, "
+        f"peak neighbor count {int(final.max_n_edges)}, "
+        f"total-energy drift {drift:.2e}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -59,12 +59,12 @@ def dynamic_radius_graph(
     n = pos.shape[0]
     disp = pos[None, :, :] - pos[:, None, :]  # [s, r, 3] = pos[r] - pos[s]
     shift = jnp.zeros_like(disp)
-    if cell is not None:
+    # periodic only when BOTH cell and pbc are given — the host builder's
+    # semantics (graphs/radius.py treats pbc=None as open space)
+    if cell is not None and pbc is not None:
         cell = jnp.asarray(cell, pos.dtype).reshape(3, 3)
         frac = disp @ jnp.linalg.inv(cell)
-        wrap = jnp.round(frac)
-        if pbc is not None:
-            wrap = wrap * jnp.asarray(pbc, pos.dtype).reshape(3)
+        wrap = jnp.round(frac) * jnp.asarray(pbc, pos.dtype).reshape(3)
         shift = -(wrap @ cell)
         disp = disp + shift
     d2 = jnp.sum(disp * disp, axis=-1)
@@ -83,11 +83,14 @@ def dynamic_radius_graph(
 
 
 class MDState(NamedTuple):
-    pos: Array       # [N, 3]
-    vel: Array       # [N, 3]
-    forces: Array    # [N, 3]
-    energy: Array    # scalar potential energy
-    n_edges: Array   # neighbor count of the last rebuild (overflow telltale)
+    pos: Array         # [N, 3]
+    vel: Array         # [N, 3]
+    forces: Array      # [N, 3]
+    energy: Array      # scalar potential energy
+    n_edges: Array     # neighbor count of the LAST rebuild
+    max_n_edges: Array  # running max over the whole trajectory — the
+    #                     overflow telltale (a transient spike between
+    #                     recorded frames cannot hide)
 
 
 def make_md_step(
@@ -117,7 +120,8 @@ def make_md_step(
 
     def init(pos, vel) -> MDState:
         (e, ne), f = jax.value_and_grad(potential, has_aux=True)(pos)
-        return MDState(pos=pos, vel=vel, forces=-f, energy=e, n_edges=ne)
+        return MDState(pos=pos, vel=vel, forces=-f, energy=e, n_edges=ne,
+                       max_n_edges=ne)
 
     @jax.jit
     def step(state: MDState) -> MDState:
@@ -133,7 +137,8 @@ def make_md_step(
         (e, ne), g = jax.value_and_grad(potential, has_aux=True)(pos)
         forces = -g
         vel = vel_half + 0.5 * dt * forces / m
-        return MDState(pos=pos, vel=vel, forces=forces, energy=e, n_edges=ne)
+        return MDState(pos=pos, vel=vel, forces=forces, energy=e, n_edges=ne,
+                       max_n_edges=jnp.maximum(state.max_n_edges, ne))
 
     return init, step
 
@@ -188,11 +193,17 @@ def mlip_energy_fn(model, variables, template) -> Callable:
     positions and neighbor arrays, so the whole MD step (graph rebuild +
     model forward + force grad + integration) stays one compiled program.
 
-    Pass ``pad_id = template dummy-node index`` (``n_node - 1``) to the
-    graph rebuild so pad edges follow the batch convention. Models whose
-    forward reads per-edge attributes or angular triplets (DimeNet) are
-    rejected: their edge_attr/idx_kj rows describe the TEMPLATE's topology
-    and would silently go stale as the neighbor list evolves."""
+    The returned function takes the REAL atoms' positions (what
+    ``make_md_step`` integrates) and scatters them into the template's
+    padded coordinate array itself, so
+    ``run_md(mlip_energy_fn(model, vars, template), ...)`` composes
+    directly. Pass ``pad_id = template dummy-node index`` (``n_node - 1``)
+    to the graph rebuild so pad edges follow the batch convention. Models
+    whose forward reads per-edge attributes or angular triplets (DimeNet)
+    are rejected: their edge_attr/idx_kj rows describe the TEMPLATE's
+    topology and would silently go stale as the neighbor list evolves."""
+    import numpy as _np
+
     from .models.mlip import make_graph_energy_fn
 
     spec = model.spec
@@ -210,8 +221,10 @@ def mlip_energy_fn(model, variables, template) -> Callable:
         )
 
     graph_energy = make_graph_energy_fn(model)
+    n_real = int(_np.asarray(template.node_mask).sum())
 
-    def energy(pos, senders, receivers, shifts, edge_mask):
+    def energy(pos_real, senders, receivers, shifts, edge_mask):
+        pos_full = template.pos.at[:n_real].set(pos_real)
         b = template.replace(
             senders=senders,
             receivers=receivers,
@@ -223,7 +236,7 @@ def mlip_energy_fn(model, variables, template) -> Callable:
             # layout (silently wrong sums), so drop to the dynamic check
             meta=None,
         )
-        return graph_energy(variables, pos, b).sum()
+        return graph_energy(variables, pos_full, b).sum()
 
     return energy
 

@@ -174,3 +174,15 @@ def test_example_qm9_hpo_parallel_trials(tmp_path):
         for s1, _ in spans[i + 1 :]
     )
     assert overlap, f"no two trials overlapped: {spans}"
+
+
+def test_example_md_rollout():
+    """Train an MLIP, then roll on-device MD with it (beyond the reference:
+    graph rebuild + forward + grad forces + Verlet in one compiled step)."""
+    out = run_example(
+        ["examples/md_rollout/md_rollout.py", "--epochs", "3", "--configs",
+         "24", "--steps", "60"],
+        timeout=600,
+    )
+    assert "MD rollout: 60 steps on-device" in out
+    assert "total-energy drift" in out
