@@ -24,7 +24,6 @@ image PBC, matching ``graphs.radius.radius_graph`` (tested for parity).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, NamedTuple
 
 import jax
@@ -93,6 +92,36 @@ class MDState(NamedTuple):
     #                     recorded frames cannot hide)
 
 
+def _make_potential_and_init(
+    energy_fn, cutoff, max_edges, cell, pbc, pad_id
+):
+    """Shared wiring for every integrator: the graph-rebuild potential and
+    the initial-state constructor — one place for the neighbor/pad
+    semantics, so NVE and NVT can never drift apart."""
+
+    def potential(pos):
+        s, r, sh, em, ne = dynamic_radius_graph(
+            pos, cutoff, max_edges, cell=cell, pbc=pbc, pad_id=pad_id
+        )
+        return energy_fn(pos, s, r, sh, em), ne
+
+    def init(pos, vel) -> MDState:
+        (e, ne), f = jax.value_and_grad(potential, has_aux=True)(pos)
+        return MDState(pos=pos, vel=vel, forces=-f, energy=e, n_edges=ne,
+                       max_n_edges=ne)
+
+    return potential, init
+
+
+def _wrap_positions(pos, cell, pbc):
+    if cell is None or pbc is None:
+        return pos
+    c = jnp.asarray(cell, pos.dtype).reshape(3, 3)
+    frac = pos @ jnp.linalg.inv(c)
+    frac = jnp.where(jnp.asarray(pbc, bool).reshape(3), frac % 1.0, frac)
+    return frac @ c
+
+
 def make_md_step(
     energy_fn: Callable,
     masses: Array,
@@ -111,29 +140,14 @@ def make_md_step(
     MLIP training loss uses (``models/mlip.py``). ``pad_id``: where padded
     edge slots point (a model's reserved dummy-node index)."""
     m = jnp.asarray(masses).reshape(-1, 1)
-
-    def potential(pos):
-        s, r, sh, em, ne = dynamic_radius_graph(
-            pos, cutoff, max_edges, cell=cell, pbc=pbc, pad_id=pad_id
-        )
-        return energy_fn(pos, s, r, sh, em), ne
-
-    def init(pos, vel) -> MDState:
-        (e, ne), f = jax.value_and_grad(potential, has_aux=True)(pos)
-        return MDState(pos=pos, vel=vel, forces=-f, energy=e, n_edges=ne,
-                       max_n_edges=ne)
+    potential, init = _make_potential_and_init(
+        energy_fn, cutoff, max_edges, cell, pbc, pad_id
+    )
 
     @jax.jit
     def step(state: MDState) -> MDState:
         vel_half = state.vel + 0.5 * dt * state.forces / m
-        pos = state.pos + dt * vel_half
-        if cell is not None and pbc is not None:
-            c = jnp.asarray(cell, pos.dtype).reshape(3, 3)
-            frac = pos @ jnp.linalg.inv(c)
-            frac = jnp.where(
-                jnp.asarray(pbc, bool).reshape(3), frac % 1.0, frac
-            )
-            pos = frac @ c
+        pos = _wrap_positions(state.pos + dt * vel_half, cell, pbc)
         (e, ne), g = jax.value_and_grad(potential, has_aux=True)(pos)
         forces = -g
         vel = vel_half + 0.5 * dt * forces / m
@@ -183,6 +197,57 @@ def run_md(
         return jax.lax.scan(body, state, None, length=n_rec)
 
     return segment(state)
+
+
+def make_langevin_step(
+    energy_fn: Callable,
+    masses: Array,
+    dt: float,
+    cutoff: float,
+    max_edges: int,
+    temperature: float,
+    friction: float = 1.0,
+    cell: Array | None = None,
+    pbc: Array | None = None,
+    pad_id: int = 0,
+):
+    """NVT Langevin integrator (BAOAB splitting): the velocity-Verlet B/A
+    halves wrap an Ornstein-Uhlenbeck velocity kick, which is exact for the
+    friction/noise part — the standard low-dt-bias sampler. ``temperature``
+    is in energy units (k_B T); the returned step takes and threads a PRNG
+    key: ``state, key = step(state, key)``."""
+    m = jnp.asarray(masses).reshape(-1, 1)
+    c1 = jnp.exp(-friction * dt)
+    c2 = jnp.sqrt(temperature * (1.0 - c1 * c1))
+    potential, init = _make_potential_and_init(
+        energy_fn, cutoff, max_edges, cell, pbc, pad_id
+    )
+
+    @jax.jit
+    def step(state: MDState, key):
+        key, sub = jax.random.split(key)
+        vel = state.vel + 0.5 * dt * state.forces / m          # B
+        pos = state.pos + 0.5 * dt * vel                        # A
+        noise = jax.random.normal(sub, vel.shape, vel.dtype)
+        vel = c1 * vel + c2 * jnp.sqrt(1.0 / m) * noise         # O (exact OU)
+        pos = _wrap_positions(pos + 0.5 * dt * vel, cell, pbc)  # A
+        (e, ne), g = jax.value_and_grad(potential, has_aux=True)(pos)
+        forces = -g
+        vel = vel + 0.5 * dt * forces / m                       # B
+        return (
+            MDState(pos=pos, vel=vel, forces=forces, energy=e, n_edges=ne,
+                    max_n_edges=jnp.maximum(state.max_n_edges, ne)),
+            key,
+        )
+
+    return init, step
+
+
+def temperature_of(vel: Array, masses: Array) -> Array:
+    """Instantaneous kinetic temperature in energy units (k_B T):
+    2 KE / (3 N)."""
+    n = vel.shape[0]
+    return 2.0 * kinetic_energy(vel, masses) / (3.0 * n)
 
 
 def mlip_energy_fn(model, variables, template) -> Callable:
@@ -247,6 +312,6 @@ def kinetic_energy(vel: Array, masses: Array) -> Array:
 
 
 __all__ = [
-    "MDState", "dynamic_radius_graph", "kinetic_energy", "make_md_step",
-    "mlip_energy_fn", "run_md",
+    "MDState", "dynamic_radius_graph", "kinetic_energy", "make_langevin_step",
+    "make_md_step", "mlip_energy_fn", "run_md", "temperature_of",
 ]

@@ -199,3 +199,37 @@ def test_md_with_mlip_model_energy():
     assert np.isfinite(float(state.energy))
     assert np.all(np.isfinite(np.asarray(state.pos)))
     assert int(state.max_n_edges) <= max_edges
+
+
+def test_langevin_thermostat_equilibrates_to_target_temperature():
+    """NVT Langevin (BAOAB): starting cold, the kinetic temperature must
+    relax to the target k_B T and hold there (time-averaged, fixed seed)."""
+    from hydragnn_tpu.md import make_langevin_step, temperature_of
+
+    rng = np.random.default_rng(4)
+    n = 32
+    pos = jnp.asarray(rng.uniform(0, 5.0, size=(n, 3)), jnp.float32)
+    vel = jnp.zeros((n, 3), jnp.float32)
+    masses = jnp.ones((n,), jnp.float32)
+    cutoff = 1.5
+    kT = 0.5
+
+    def energy(p, s, r, sh, em):
+        vec = p[r] - p[s] + sh
+        d = jnp.sqrt(jnp.sum(vec * vec, axis=-1) + 1e-12)
+        return 0.5 * jnp.sum(em * 0.5 * (cutoff - d) ** 2)
+
+    init, step = make_langevin_step(
+        energy, masses, dt=5e-3, cutoff=cutoff, max_edges=2048,
+        temperature=kT, friction=2.0,
+    )
+    state = init(pos, vel)
+    key = jax.random.PRNGKey(0)
+    temps = []
+    for i in range(600):
+        state, key = step(state, key)
+        if i >= 200:  # after equilibration
+            temps.append(float(temperature_of(state.vel, masses)))
+    t_mean = float(np.mean(temps))
+    assert np.isfinite(t_mean)
+    assert abs(t_mean - kT) < 0.15 * kT, f"T={t_mean:.3f} vs target {kT}"
