@@ -56,6 +56,32 @@ _PEAK_FLOPS = [
     ("v2", 45e12),
 ]
 
+# Placeholder per-chip power (W) for the energy-proxy column (the reference
+# records real uJ counters per span, tracer.py:114-358; SURVEY S2.9 allows a
+# proxy until hardware telemetry exists). Public TDP-class figures.
+_TDP_W = [
+    ("v6", 230.0),
+    ("v5p", 350.0),
+    ("v5", 170.0),  # v5e
+    ("v4", 192.0),
+    ("v3", 220.0),
+    ("v2", 280.0),
+]
+
+
+def _lookup_by_kind(table, device_kind: str) -> float | None:
+    """First substring match wins — both tables order more-specific kinds
+    (v5p) before their prefixes (v5)."""
+    kind = device_kind.lower()
+    for key, val in table:
+        if key in kind:
+            return val
+    return None
+
+
+def _tdp_w(device_kind: str) -> float | None:
+    return _lookup_by_kind(_TDP_W, device_kind)
+
 _emit_lock = threading.Lock()
 _emitted = False
 
@@ -71,11 +97,10 @@ def _emit(record: dict) -> None:
 
 
 def _peak_flops(device_kind: str, compute_dtype: str) -> float | None:
-    kind = device_kind.lower()
-    for key, val in _PEAK_FLOPS:
-        if key in kind:
-            return val / 2 if compute_dtype == "fp32" else val
-    return None
+    val = _lookup_by_kind(_PEAK_FLOPS, device_kind)
+    if val is None:
+        return None
+    return val / 2 if compute_dtype == "fp32" else val
 
 
 def make_qm9_like_samples(n: int, seed: int = 0, forces: bool = False):
@@ -260,6 +285,12 @@ def _run_workload(
         peak = _peak_flops(jax.devices()[0].device_kind, compute_dtype_name)
         if peak:
             rec["mfu"] = round(flops / (dt / bench_steps) / peak, 5)
+    tdp = _tdp_w(jax.devices()[0].device_kind)
+    if tdp and jax.default_backend() == "tpu":
+        # step time x assumed chip TDP: the reference's per-span energy
+        # column as a proxy until real counters exist (VERDICT r4 item 10)
+        rec["energy_proxy_j_per_step"] = round(dt / bench_steps * tdp, 4)
+        rec["tdp_w_assumed"] = tdp
     return rec
 
 
@@ -417,6 +448,181 @@ def bench_mlip(batch_size: int, bench_steps: int, warmup: int) -> dict:
     )
 
 
+# Per-architecture knobs for the step-time sweep: the e2e-test-proven
+# settings (tests/test_training_e2e.py ARCH_OVERRIDES) with bench-scale
+# hidden dims. MACE and DimeNet are the FLOP monsters (VERDICT r4 item 1).
+ARCH_SWEEP_OVERRIDES = {
+    "GIN": {},
+    "SAGE": {},
+    "GAT": {},
+    "MFC": {"max_neighbours": 20},
+    "CGCNN": {},
+    "PNA": {},
+    "PNAPlus": {"num_radial": 5, "envelope_exponent": 5},
+    "SchNet": {"num_gaussians": 20, "num_filters": 64},
+    "EGNN": {},
+    "PAINN": {"num_radial": 6, "hidden_dim": 32},
+    "PNAEq": {"num_radial": 6, "hidden_dim": 32},
+    "DimeNet": {
+        "num_radial": 6,
+        "num_spherical": 7,
+        "int_emb_size": 64,
+        "basis_emb_size": 8,
+        "out_emb_size": 64,
+        "num_before_skip": 1,
+        "num_after_skip": 2,
+        "envelope_exponent": 5,
+    },
+    "MACE": {
+        "max_ell": 1,
+        "node_max_ell": 1,
+        "correlation": 2,
+        "num_radial": 6,
+        "radial_type": "bessel",
+        "hidden_dim": 32,
+    },
+}
+
+
+def bench_arch(arch: str, batch_size: int, bench_steps: int, warmup: int) -> dict:
+    """One architecture's step time through the shared protocol: compile +
+    a short steady-state span on the flagship multi-head config, bf16.
+    Emitted one row per arch so a partial window keeps finished archs."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.train import make_train_step
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    a = cfg["NeuralNetwork"]["Architecture"]
+    a["mpnn_type"] = arch
+    a["hidden_dim"] = 64
+    a.update(ARCH_SWEEP_OVERRIDES.get(arch, {}))
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    cfg["NeuralNetwork"]["Training"]["precision"] = "bf16"
+    samples = make_qm9_like_samples(max(batch_size * 2, 256), seed=13)
+    if arch == "DimeNet":
+        from hydragnn_tpu.graphs.triplets import attach_triplets
+
+        for s in samples:
+            attach_triplets(s)
+    return _run_workload(
+        f"arch_{arch}", cfg, samples,
+        lambda m, o: make_train_step(m, o, compute_dtype=jnp.bfloat16),
+        "bf16", batch_size, bench_steps, warmup,
+    )
+
+
+def _stage_gs_batch(n_samples: int, batch_size: int, c: int, seed: int,
+                    h_seed: int = 5):
+    """Shared gather-scatter staging for autotune + pallas_validate: REAL
+    collate layout (per-sample edge locality, receiver-sorted, host-certified
+    meta) + random fp32 features. Returns (batch, n, h, snd, rcv, w)."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+
+    samples = make_qm9_like_samples(n_samples, seed=seed)
+    pad = compute_pad_spec(samples, batch_size)
+    b = collate(samples[:batch_size], pad)
+    n = int(b.x.shape[0])
+    rng = np.random.default_rng(h_seed)
+    h = jnp.asarray(rng.normal(size=(n, c)), jnp.float32)
+    snd, rcv = jnp.asarray(b.senders), jnp.asarray(b.receivers)
+    w = jnp.asarray(np.asarray(b.edge_mask), jnp.float32)
+    return b, n, h, snd, rcv, w
+
+
+def bench_fused_autotune(batch_size: int = 128, reps: int = 30) -> dict:
+    """(window, block_edges) autotune sweep for the fused gather-scatter
+    kernel on a production-bucket batch (VERDICT r4 item 1): each geometry
+    host-certified via ``window_fits_host`` before timing, vs the XLA
+    gather+segment_sum reference on the same batch, in BOTH compute dtypes
+    (bf16 = the production conv-stack path, fp32 = the MLIP path; the MXU
+    precision mode differs, so the optimum can too). On CPU this runs in
+    interpret mode — only a TPU window's numbers are tuning data."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.fused_scatter import (
+        fused_gather_scatter,
+        reference_gather_scatter,
+        window_fits_host,
+    )
+
+    c = 64
+    b, n, h32, snd, rcv, w = _stage_gs_batch(
+        max(batch_size * 2, 256), batch_size, c, seed=17
+    )
+    snd_np, rcv_np = np.asarray(b.senders), np.asarray(b.receivers)
+    inputs = {"bf16": h32.astype(jnp.bfloat16), "fp32": h32}
+
+    def time_call(fn, h):
+        out = fn(h, snd, rcv, w)  # compile
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(h, snd, rcv, w)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    rec: dict = {
+        "workload": "fused_autotune",
+        "backend": jax.default_backend(),
+        "n_node": n, "n_edge": int(snd.shape[0]), "channels": c,
+        "batch_size": batch_size,
+    }
+    on_tpu = jax.default_backend() == "tpu"
+    ref_ms = {}
+    if on_tpu:
+        for dt, h in inputs.items():
+            ref_ms[dt] = time_call(
+                jax.jit(lambda h, s, r, w: reference_gather_scatter(h, s, r, n, w)),
+                h,
+            )
+        rec["xla_reference_ms"] = {k: round(v, 4) for k, v in ref_ms.items()}
+    geoms = []
+    for window, block_edges in ((128, 128), (256, 256), (256, 512), (512, 256)):
+        fits = (
+            window_fits_host(snd_np, n, window, block_edges, exempt_pad_id=True)
+            and window_fits_host(rcv_np, n, window, block_edges,
+                                 exempt_pad_id=True)
+        )
+        entry = {"window": window, "block_edges": block_edges,
+                 "certified": bool(fits)}
+        if not on_tpu:
+            # interpret-mode timings are meaningless; record certification
+            # only so CPU smoke runs stay fast
+            entry["skipped_timing"] = "non-tpu backend"
+        elif fits and n >= window:
+            for dt, h in inputs.items():
+                # cert_geometry keeps the host certificate at this geometry,
+                # so the timing is the static kernel-only path (no cond)
+                ms = time_call(
+                    jax.jit(
+                        lambda h, s, r, w, _win=window, _be=block_edges:
+                        fused_gather_scatter(h, s, r, n, w, window=_win,
+                                             block_edges=_be, fits=True,
+                                             cert_geometry=(_win, _be))
+                    ),
+                    h,
+                )
+                entry[f"ms_{dt}"] = round(ms, 4)
+                entry[f"speedup_vs_xla_{dt}"] = round(ref_ms[dt] / ms, 4)
+        geoms.append(entry)
+    rec["geometries"] = geoms
+    for dt in inputs:
+        timed = [g for g in geoms if f"ms_{dt}" in g]
+        if timed:
+            best = min(timed, key=lambda g: g[f"ms_{dt}"])
+            rec[f"best_{dt}"] = {
+                "window": best["window"], "block_edges": best["block_edges"],
+                "ms": best[f"ms_{dt}"],
+                "speedup_vs_xla": best[f"speedup_vs_xla_{dt}"],
+            }
+    return rec
+
+
 def bench_pallas_validate() -> dict:
     """HARDWARE validation of the fused gather-scatter kernel (round-3
     verdict #1's third demand): numeric parity fused-vs-XLA on the real
@@ -433,9 +639,6 @@ def bench_pallas_validate() -> dict:
         reference_gather_scatter,
     )
 
-    from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
-
-    rng = np.random.default_rng(0)
     rec: dict = {"workload": "pallas_validate",
                  "backend": jax.default_backend()}
 
@@ -443,15 +646,9 @@ def bench_pallas_validate() -> dict:
         """REAL collate layout (per-sample edge locality, receiver-sorted,
         host-certified gs_fits) — uniform-random ids would violate the
         256-window contract and silently compare the XLA path with itself."""
-        samples = make_qm9_like_samples(n_samples, seed=3)
-        pad = compute_pad_spec(samples, batch_size)
-        b = collate(samples[:batch_size], pad)
-        n = b.x.shape[0]
+        b, n, h, snd, rcv, w = _stage_gs_batch(n_samples, batch_size, c,
+                                               seed=3, h_seed=0)
         fits = bool(b.meta.gs_fits) if b.meta is not None else None
-        h = jnp.asarray(rng.normal(size=(n, c)), jnp.float32)
-        snd = jnp.asarray(b.senders)
-        rcv = jnp.asarray(b.receivers)
-        w = jnp.asarray(np.asarray(b.edge_mask), jnp.float32)
         kernel_engaged = bool(_static_ok(h, snd, n, 256)) and bool(fits)
         out_f = jax.jit(
             lambda h, s, r, w: fused_gather_scatter(h, s, r, n, w, fits=fits)
@@ -592,6 +789,19 @@ def child_main(status_path: str) -> None:
     plan.append(
         ("inference", lambda: bench_inference(batch_size, bench_steps, warmup))
     )
+    if os.getenv("BENCH_FUSED_AUTOTUNE", "1") != "0":
+        # cheap kernel-only sweep BEFORE the compile-heavy arch entries, so
+        # a short window still yields the tuning data it was added for
+        plan.append(("fused_autotune", bench_fused_autotune))
+    if os.getenv("BENCH_ARCH_SWEEP", "1") != "0":
+        # one plan entry per architecture: a partial window keeps every arch
+        # that finished (VERDICT r4 item 1 + 8)
+        sweep_bs = int(os.getenv("BENCH_SWEEP_BATCH_SIZE", "128"))
+        for arch in ARCH_SWEEP_OVERRIDES:
+            plan.append(
+                (f"arch_{arch}",
+                 lambda a=arch: bench_arch(a, sweep_bs, 5, 2))
+            )
 
     done: set = set()
     for name, fn in plan:
@@ -623,18 +833,28 @@ def child_main(status_path: str) -> None:
 def _load_snapshot() -> dict | None:
     """Freshest successful bench record captured by the probe loop this round
     (logs/bench_snapshots/). Lets a dead-tunnel end-of-round run still report
-    the real numbers measured during any earlier up-window."""
-    best = None
-    for path in sorted(glob.glob("logs/bench_snapshots/bench_*.json")):
+    the real numbers measured during any earlier up-window. A window that
+    died before the headline gin row still counts if ANY workload row
+    finished (.failed snapshots, VERDICT r4 item 8) — a full record always
+    wins over a partial one."""
+    best = partial = None
+    for path in sorted(
+        glob.glob("logs/bench_snapshots/bench_*.json")
+        + glob.glob("logs/bench_snapshots/bench_*.json.failed")
+    ):
         try:
             with open(path) as fh:
                 rec = json.loads(fh.read().strip().splitlines()[-1])
             if rec.get("value"):
                 best = rec
                 best["cached_from_snapshot"] = os.path.basename(path)
+            elif rec.get("workloads"):
+                partial = rec
+                partial["cached_from_snapshot"] = os.path.basename(path)
+                partial["partial_window"] = True
         except Exception:
             pass
-    return best
+    return best or partial
 
 
 def _assemble(status_path: str, note: str | None) -> dict:
@@ -648,6 +868,7 @@ def _assemble(status_path: str, note: str | None) -> dict:
     }
     workloads: dict = {}
     errors: dict = {}
+    skipped: dict = {}
     lines = []
     try:
         with open(status_path) as fh:
@@ -673,6 +894,11 @@ def _assemble(status_path: str, note: str | None) -> dict:
                     workloads.setdefault("gin", {}).update(rec["result"])
                 else:
                     workloads.setdefault(rec["name"], {}).update(rec["result"])
+            elif str(rec.get("error", "")).startswith("skipped:"):
+                # budget/precondition skips are not failures: a successful
+                # headline run must not read as errored because optional
+                # tail rows ran out of window
+                skipped[rec["name"]] = rec["error"]
             else:
                 errors[rec["name"]] = rec.get("error", "unknown")
     if workloads.get("gin", {}).get("graphs_per_sec_per_chip"):
@@ -681,6 +907,8 @@ def _assemble(status_path: str, note: str | None) -> dict:
         record["vs_baseline"] = round(record["value"] / prev, 3) if prev else 1.0
     if workloads:
         record["workloads"] = workloads
+    if skipped:
+        record["skipped"] = skipped
     if note:
         errors["parent"] = note  # distinct key: keep the child's traceback too
     if errors:
@@ -749,9 +977,11 @@ def parent_main() -> None:
     record = _assemble(status_path, note)
     if not record.get("value"):
         snap = _load_snapshot()
-        if snap is not None:
-            # live run failed (tunnel down) but the probe loop captured real
-            # numbers earlier this round — report those, noting the source
+        # a snapshot replaces the live record only when it is strictly
+        # better: full (has value) always, partial only if the live run
+        # produced no workload rows at all — never discard fresh rows for
+        # a stale .failed snapshot
+        if snap is not None and (snap.get("value") or not record.get("workloads")):
             snap.setdefault("error_detail", {})["live_run"] = record.get(
                 "error", "no measurement"
             )

@@ -334,6 +334,7 @@ def fused_gather_scatter(
     block_edges: int = 256,
     interpret: bool | None = None,
     fits: bool | None = None,
+    cert_geometry: tuple[int, int] | None = None,
 ) -> Array:
     """``segment_sum(weight * h[senders], receivers, num_nodes)`` fused in one
     Pallas kernel. ``fits`` is the host-certified layout guarantee
@@ -345,8 +346,12 @@ def fused_gather_scatter(
     checked against — collate certifies the defaults
     (``GS_CERT_WINDOW``/``GS_CERT_BLOCK``); any other geometry drops the
     certificate and re-enters the dynamic in-program check rather than
-    silently trusting an uncertified layout."""
-    if (window, block_edges) != (GS_CERT_WINDOW, GS_CERT_BLOCK):
+    silently trusting an uncertified layout. A caller that ran
+    ``window_fits_host`` itself against a non-default geometry states that
+    via ``cert_geometry=(window, block_edges)`` to keep its certificate
+    (the autotune sweep's path)."""
+    if (window, block_edges) not in ((GS_CERT_WINDOW, GS_CERT_BLOCK),
+                                     cert_geometry):
         fits = None
     if weight is None:
         weight = jnp.ones(senders.shape[0], dtype=h.dtype)
