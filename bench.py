@@ -353,6 +353,94 @@ def bench_loader(batch_size: int) -> dict:
     }
 
 
+# Serve the remote shard from a SEPARATE process: a same-process loopback
+# server would share the client's GIL and misreport the overlap the pool
+# buys (the real deployment is always cross-process/cross-host).
+_SHARD_SERVER_SCRIPT = """
+import sys, time
+from hydragnn_tpu.datasets.sharded import ShardedStore
+path, start, stop = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+srv = ShardedStore(path, start, stop,
+                   peers=[("127.0.0.1", 0, 0, start),
+                          ("127.0.0.1", 0, start, stop)])
+print(srv.server.port, flush=True)
+while True:
+    time.sleep(60)
+"""
+
+
+def bench_sharded(n_samples: int = 512, batch: int = 32) -> dict:
+    """ShardedStore data-plane row (round-4 verdict item 2's bench demand):
+    samples/sec through the TCP remote-fetch tier vs the local mmap tier,
+    and the 4-worker overlap factor on the TCP path. Host-only (loopback,
+    server in a subprocess); the client store owns half the corpus."""
+    import shutil
+    import subprocess
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+
+    samples = make_qm9_like_samples(n_samples, seed=23)
+    half = n_samples // 2
+    tmp = tempfile.mkdtemp(prefix="bench_sharded_")
+    srv_proc = None
+    try:
+        p0, p1 = os.path.join(tmp, "a.gpk"), os.path.join(tmp, "b.gpk")
+        PackedWriter(samples[:half], p0)
+        PackedWriter(samples[half:], p1)
+        srv_proc = subprocess.Popen(
+            [sys.executable, "-c", _SHARD_SERVER_SCRIPT, p1, str(half),
+             str(n_samples)],
+            stdout=subprocess.PIPE, text=True,
+        )
+        port = int(srv_proc.stdout.readline())
+        s0 = ShardedStore(
+            p0, 0, half, cache_size=1,  # cache off: measure the wire
+            peers=[("127.0.0.1", 0, 0, half),
+                   ("127.0.0.1", port, half, n_samples)],
+        )
+        try:
+            if half < batch:
+                raise ValueError(f"need n_samples >= 2*batch, got {n_samples}")
+            local_chunks = [list(range(i, i + batch))
+                            for i in range(0, half - batch + 1, batch)]
+            remote_chunks = [list(range(i, i + batch))
+                             for i in range(half, n_samples - batch + 1, batch)]
+
+            def run(chunks, workers):
+                t0 = time.perf_counter()
+                if workers == 1:
+                    for ch in chunks:
+                        s0.fetch(ch)
+                else:
+                    with ThreadPoolExecutor(workers) as ex:
+                        list(ex.map(s0.fetch, chunks))
+                dt = time.perf_counter() - t0
+                return len(chunks) * batch / dt
+
+            local_sps = run(local_chunks, 1)
+            tcp_sps = run(remote_chunks, 1)
+            tcp4_sps = run(remote_chunks, 4)
+            return {
+                "workload": "sharded_store",
+                "local_mmap_samples_per_sec": round(local_sps, 1),
+                "tcp_samples_per_sec": round(tcp_sps, 1),
+                "tcp_4worker_samples_per_sec": round(tcp4_sps, 1),
+                "tcp_overlap_x": round(tcp4_sps / tcp_sps, 3),
+                "tcp_vs_local": round(tcp_sps / local_sps, 4),
+                "batch": batch,
+            }
+        finally:
+            s0.close()
+    finally:
+        if srv_proc is not None:
+            srv_proc.terminate()
+            srv_proc.wait(timeout=10)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_gin(batch_size: int, bench_steps: int, warmup: int) -> dict:
     """Flagship multi-head GIN on QM9-like graphs, bf16 compute."""
     import jax.numpy as jnp
@@ -756,6 +844,7 @@ def child_main(status_path: str) -> None:
 
     plan: list = [
         ("loader", lambda: bench_loader(batch_size)),
+        ("sharded", bench_sharded),
         ("gin", lambda: bench_gin(batch_size, bench_steps, warmup)),
         ("mlip", lambda: bench_mlip(min(batch_size, 64), bench_steps, warmup)),
         ("gps", lambda: bench_gps(min(batch_size, 128), bench_steps, warmup)),

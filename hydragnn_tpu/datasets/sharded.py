@@ -23,19 +23,25 @@ each host's per-epoch stride of the shared global permutation now spans the
 WHOLE corpus (the DDStore property), fetching the ~(world-1)/world
 non-local samples from their owners.
 
-Wire format is ``.npz`` (``allow_pickle=False`` — a malicious peer cannot
-execute code on load); the trust model is otherwise the reference's: an
-internal cluster network, like its MPI windows.
+Wire format is a length-prefixed binary array framing (name + dtype str +
+shape + raw bytes per array): decode is ``np.frombuffer`` views — no
+pickle anywhere, and object dtypes are rejected on both ends, so a
+malicious peer cannot execute code on load. The trust model is otherwise
+the reference's — an internal cluster network, like its MPI windows —
+hardened further by an optional ``auth_token`` handshake and a bindable
+listen interface.
 """
 
 from __future__ import annotations
 
-import io
 import socket
 import socketserver
 import struct
+import sys
 import threading
+import time
 from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -47,6 +53,81 @@ _HDR = struct.Struct("<q")  # payload byte length
 
 def _send_msg(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+_MAGIC = b"GSX1"
+
+
+def _pack_arrays(d: dict[str, np.ndarray]) -> bytes:
+    """dict[str, ndarray] -> compact binary frame. ~50x faster than ``.npz``
+    (zipfile is pure Python and dominated the TCP tier's CPU budget); the
+    dtype travels as its ``.str`` spec, never as a pickled object."""
+    parts = [_MAGIC, struct.pack("<I", len(d))]
+    for k, v in d.items():
+        v = np.ascontiguousarray(v)
+        if v.dtype.hasobject:
+            raise ValueError("object arrays are not allowed on the wire")
+        name = k.encode()
+        dt = v.dtype.str.encode()
+        parts.append(struct.pack("<H", len(name)))
+        parts.append(name)
+        parts.append(struct.pack("<B", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<B", v.ndim))
+        if v.ndim:
+            parts.append(struct.pack(f"<{v.ndim}q", *v.shape))
+        raw = v.tobytes()
+        parts.append(struct.pack("<q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def _unpack_arrays(buf: bytes) -> dict[str, np.ndarray]:
+    """Inverse of ``_pack_arrays``; arrays are zero-copy views into ``buf``.
+    Every length is validated against the payload before slicing, and ANY
+    malformed frame — bad magic, truncated header, unknown dtype — raises
+    ``ValueError`` (never struct.error/TypeError leaking to callers)."""
+    try:
+        if buf[:4] != _MAGIC:
+            raise ValueError(
+                "bad wire magic (peer speaks a different protocol?)"
+            )
+        mv = memoryview(buf)
+        off = 4
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        out: dict[str, np.ndarray] = {}
+        for _ in range(n):
+            (nl,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            if off + nl > len(buf):
+                raise ValueError("truncated frame (name)")
+            name = bytes(mv[off:off + nl]).decode()
+            off += nl
+            (dl,) = struct.unpack_from("<B", buf, off)
+            off += 1
+            if off + dl > len(buf):
+                raise ValueError("truncated frame (dtype)")
+            dt = np.dtype(bytes(mv[off:off + dl]).decode())
+            off += dl
+            if dt.hasobject:
+                raise ValueError("object arrays are not allowed on the wire")
+            (nd,) = struct.unpack_from("<B", buf, off)
+            off += 1
+            shape = struct.unpack_from(f"<{nd}q", buf, off) if nd else ()
+            off += 8 * nd
+            (nb,) = struct.unpack_from("<q", buf, off)
+            off += 8
+            count = int(np.prod(shape, dtype=np.int64)) if nd else 1
+            if count < 0 or nb != count * dt.itemsize or off + nb > len(buf):
+                raise ValueError(f"corrupt frame for array {name!r}")
+            out[name] = np.frombuffer(mv[off:off + nb], dtype=dt).reshape(shape)
+            off += nb
+        return out
+    except ValueError:
+        raise
+    except (struct.error, TypeError, UnicodeDecodeError) as e:
+        raise ValueError(f"corrupt frame: {e}") from None
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -88,82 +169,129 @@ def _sample_to_arrays(s: GraphSample) -> dict[str, np.ndarray]:
 
 
 def _sample_from_arrays(d: dict[str, np.ndarray]) -> GraphSample:
-    kw = {f: d[f] for f in _ARRAY_FIELDS if f in d}
+    # np.array: decoded frames are read-only frombuffer views; samples must
+    # be writable (downstream transforms mutate in place)
+    kw = {f: np.array(d[f]) for f in _ARRAY_FIELDS if f in d}
     s = GraphSample(dataset_id=int(d["dataset_id"]), **kw)
     for f in _EXTRA_FIELDS:
         if "extra_" + f in d:
-            s.extras[f] = d["extra_" + f]
+            s.extras[f] = np.array(d["extra_" + f])
     return s
 
 
 def _encode_samples(samples: list[GraphSample]) -> bytes:
-    buf = io.BytesIO()
     flat = {}
     for i, s in enumerate(samples):
         for k, v in _sample_to_arrays(s).items():
             flat[f"s{i}_{k}"] = v
     flat["n"] = np.asarray(len(samples), np.int64)
-    np.savez(buf, **flat)
-    return buf.getvalue()
+    return _pack_arrays(flat)
 
 
 def _decode_samples(payload: bytes) -> list[GraphSample]:
-    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
-        n = int(z["n"])
-        out = []
-        for i in range(n):
-            prefix = f"s{i}_"
-            d = {k[len(prefix):]: z[k] for k in z.files if k.startswith(prefix)}
-            out.append(_sample_from_arrays(d))
+    return _samples_from_frame(_unpack_arrays(payload))
+
+
+def _samples_from_frame(z: dict[str, np.ndarray]) -> list[GraphSample]:
+    n = int(z["n"])
+    out = []
+    for i in range(n):
+        prefix = f"s{i}_"
+        d = {k[len(prefix):]: v for k, v in z.items() if k.startswith(prefix)}
+        out.append(_sample_from_arrays(d))
     return out
 
 
 class ShardServer:
     """Threaded TCP server answering batched sample fetches from the local
-    shard. Request: npz {"idx": int64[k] LOCAL indices, "range": [start,
-    stop] the GLOBAL range the client believes this server owns}; response:
+    shard. Request: a ``_pack_arrays`` frame {"idx": int64[k] LOCAL indices,
+    "range": [start, stop] the GLOBAL range the client believes this server
+    owns}; response:
     the encoded samples, or an error record when the range doesn't match —
     a misrouted connection (e.g. every host advertising a loopback address,
     so peers dial their OWN server) must fail LOUDLY, not silently serve
-    wrong samples."""
+    wrong samples.
+
+    ``host`` restricts the listening interface (default all interfaces —
+    the reference's MPI-window trust model on an isolated cluster fabric);
+    ``auth_token`` adds a per-request shared-secret check for multi-tenant
+    networks (n=-2 error record on mismatch). ``_test_delay_s`` is a test
+    hook: a per-request sleep that makes fetch-overlap measurements
+    deterministic instead of timing-noise-bound."""
 
     def __init__(self, ds: PackedDataset, start: int, stop: int,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", auth_token: str | None = None,
+                 _test_delay_s: float = 0.0):
         outer = self
+        tok = None if auth_token is None else np.frombuffer(
+            auth_token.encode(), np.uint8
+        )
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
                 try:
                     while True:
-                        req = _recv_msg(self.request)
-                        with np.load(io.BytesIO(req), allow_pickle=False) as z:
-                            idx = z["idx"]
-                            want = z["range"] if "range" in z.files else None
+                        try:
+                            z = _unpack_arrays(_recv_msg(self.request))
+                        except ValueError:
+                            # malformed frame: drop the connection — one
+                            # line of diagnostics, no per-request traceback
+                            # spam from a misbehaving peer
+                            print(
+                                f"[ShardServer:{outer.port}] dropping peer "
+                                f"{self.client_address}: malformed frame",
+                                file=sys.stderr,
+                            )
+                            return
+                        if outer._test_delay_s:
+                            time.sleep(outer._test_delay_s)
+                        got_tok = z.get("token")
+                        if tok is not None and (
+                            got_tok is None or got_tok.shape != tok.shape
+                            or not bool(np.all(got_tok == tok))
+                        ):
+                            _send_msg(self.request, _pack_arrays(
+                                {"n": np.asarray(-2, np.int64)}
+                            ))
+                            continue
+                        want = z.get("range")
                         if want is not None and (
                             int(want[0]) != outer.start or int(want[1]) != outer.stop
                         ):
-                            buf = io.BytesIO()
-                            np.savez(
-                                buf, n=np.asarray(-1, np.int64),
-                                have=np.asarray([outer.start, outer.stop], np.int64),
-                            )
-                            _send_msg(self.request, buf.getvalue())
-                            continue
-                        if "sizes" in z.files:
-                            # size-table op: (num_nodes, num_edges) for the
-                            # whole shard straight from the count index —
-                            # bucket planning never pulls sample content
-                            buf = io.BytesIO()
-                            np.savez(
-                                buf, n=np.asarray(0, np.int64),
-                                sizes=outer.ds.sample_sizes(
-                                    range(outer.stop - outer.start)
+                            _send_msg(self.request, _pack_arrays({
+                                "n": np.asarray(-1, np.int64),
+                                "have": np.asarray(
+                                    [outer.start, outer.stop], np.int64
                                 ),
-                            )
-                            _send_msg(self.request, buf.getvalue())
+                            }))
                             continue
-                        samples = [outer.ds[int(i)] for i in idx]
-                        _send_msg(self.request, _encode_samples(samples))
+                        try:
+                            if "sizes" in z:
+                                # size-table op: (num_nodes, num_edges) for
+                                # the whole shard straight from the count
+                                # index — bucket planning never pulls
+                                # sample content
+                                resp = _pack_arrays({
+                                    "n": np.asarray(0, np.int64),
+                                    "sizes": outer.ds.sample_sizes(
+                                        range(outer.stop - outer.start)
+                                    ),
+                                })
+                            else:
+                                resp = _encode_samples(
+                                    [outer.ds[int(i)] for i in z["idx"]]
+                                )
+                        except Exception as e:
+                            # server-side failure: tell the CLIENT what
+                            # broke instead of closing with no diagnostics
+                            resp = _pack_arrays({
+                                "n": np.asarray(-3, np.int64),
+                                "detail": np.frombuffer(
+                                    f"{type(e).__name__}: {e}".encode()[:512],
+                                    np.uint8,
+                                ),
+                            })
+                        _send_msg(self.request, resp)
                 except (ConnectionError, OSError):
                     return
 
@@ -173,6 +301,7 @@ class ShardServer:
 
         self.ds = ds
         self.start, self.stop = int(start), int(stop)
+        self._test_delay_s = float(_test_delay_s)
         self._srv = Server((host, 0), Handler)
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
@@ -181,6 +310,52 @@ class ShardServer:
     def close(self) -> None:
         self._srv.shutdown()
         self._srv.server_close()
+
+
+class _ConnPool:
+    """Per-peer socket pool. Each concurrent ``fetch`` checks out its own
+    socket (creating one when none is idle), runs its request/response
+    round-trip WITHOUT any shared lock, and returns the socket afterwards —
+    so N prefetch workers overlap N remote fetches, the concurrency the
+    reference gets from per-rank MPI RMA windows
+    (``distdataset.py:72-367``). Idle sockets per peer are capped; excess
+    ones close on release."""
+
+    def __init__(self, max_idle_per_peer: int = 4):
+        self._idle: dict[int, list[socket.socket]] = {}
+        self._lock = threading.Lock()
+        self._max_idle = int(max_idle_per_peer)
+
+    def acquire(self, rank: int, host: str, port: int) -> tuple[socket.socket, bool]:
+        """Returns (socket, from_pool). A pooled socket may have gone stale
+        while idle — callers retry once on a fresh one; a FRESH connection
+        failing is a real error."""
+        with self._lock:
+            stack = self._idle.get(rank)
+            if stack:
+                return stack.pop(), True
+        return socket.create_connection((host, port), timeout=120), False
+
+    def release(self, rank: int, sock: socket.socket) -> None:
+        with self._lock:
+            stack = self._idle.setdefault(rank, [])
+            if len(stack) < self._max_idle:
+                stack.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            for stack in self._idle.values():
+                for sock in stack:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            self._idle.clear()
 
 
 class ShardedStore:
@@ -199,6 +374,10 @@ class ShardedStore:
         peers: list[tuple[str, int, int, int]] | None = None,
         cache_size: int = 4096,
         advertise_host: str | None = None,
+        bind_host: str = "0.0.0.0",
+        auth_token: str | None = None,
+        max_idle_conns_per_peer: int = 4,
+        _test_delay_s: float = 0.0,
     ):
         self.ds = PackedDataset(shard_path)
         if len(self.ds.subset) != stop - start:
@@ -207,7 +386,9 @@ class ShardedStore:
                 f"claims global range [{start}, {stop})"
             )
         self.start, self.stop = int(start), int(stop)
-        self.server = ShardServer(self.ds, start, stop)
+        self.server = ShardServer(self.ds, start, stop, host=bind_host,
+                                  auth_token=auth_token,
+                                  _test_delay_s=_test_delay_s)
         if peers is None:
             peers = self._allgather_peers(advertise_host)
         self.peers = sorted(peers, key=lambda p: p[2])  # by start index
@@ -218,11 +399,15 @@ class ShardedStore:
             if s0 != cursor:
                 raise ValueError(f"shard ranges not contiguous: {spans}")
             cursor = s1
-        self._conns: dict[int, socket.socket] = {}
+        self._auth_token = auth_token
+        self._pool = _ConnPool(max_idle_conns_per_peer)
+        # the lock guards ONLY cache/telemetry bookkeeping; network
+        # round-trips run outside it so concurrent fetches overlap
         self._lock = threading.Lock()
         self._cache: OrderedDict[int, GraphSample] = OrderedDict()
         self._cache_size = int(cache_size)
         self._sizes: np.ndarray | None = None  # lazy global size table
+        self._sizes_lock = threading.Lock()
         self.remote_fetches = 0  # telemetry: audited by tests/bench
 
     def _allgather_peers(self, advertise_host: str | None):
@@ -251,12 +436,59 @@ class ShardedStore:
                 return rank, h, p, s0
         raise IndexError(i)
 
-    def _conn(self, rank: int, host: str, port: int) -> socket.socket:
-        sock = self._conns.get(rank)
-        if sock is None:
-            sock = socket.create_connection((host, port), timeout=120)
-            self._conns[rank] = sock
-        return sock
+    def _request(self, rank: int, host: str, port: int, **fields) -> bytes:
+        """One request/response round-trip on a pooled socket — no shared
+        lock held, so concurrent callers overlap their network waits. The
+        socket returns to the pool only after a clean round-trip; any error
+        closes it (a half-read stream cannot be reused)."""
+        if self._auth_token is not None:
+            fields["token"] = np.frombuffer(self._auth_token.encode(), np.uint8)
+        req = _pack_arrays(fields)
+        while True:
+            sock, from_pool = self._pool.acquire(rank, host, port)
+            try:
+                _send_msg(sock, req)
+                payload = _recv_msg(sock)
+            except BaseException as e:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                # a socket parked idle in the pool can be dropped by the
+                # peer/NAT at any time; the request is idempotent, so retry
+                # it ONCE on a fresh connection before giving up
+                if from_pool and isinstance(e, (ConnectionError, OSError)):
+                    continue
+                raise
+            self._pool.release(rank, sock)
+            return payload
+
+    @staticmethod
+    def _check_status(z: dict[str, np.ndarray], host: str, port: int,
+                      s0: int, s1: int):
+        n = int(z["n"])
+        if n == -3:
+            detail = bytes(np.asarray(z.get("detail", []), np.uint8)).decode(
+                errors="replace"
+            )
+            raise RuntimeError(
+                f"shard server at {host}:{port} failed serving the request: "
+                f"{detail or 'unknown error'}"
+            )
+        if n == -2:
+            raise RuntimeError(
+                f"shard fetch rejected by {host}:{port}: auth token "
+                "mismatch (pass the same auth_token on every host)"
+            )
+        if n == -1:
+            have = z.get("have", "?")
+            raise RuntimeError(
+                f"shard fetch misrouted: peer at {host}:{port} "
+                f"owns global range {have}, expected [{s0}, {s1})"
+                " — check the advertised addresses (loopback "
+                "hostnames on multi-host clusters are the usual "
+                "cause; pass advertise_host explicitly)"
+            )
 
     def __getitem__(self, i) -> GraphSample:
         i = int(i)
@@ -270,66 +502,72 @@ class ShardedStore:
         int64s per sample), so bucket planning never turns into per-sample
         content fetches across the network."""
         if self._sizes is None:
-            self._sizes = self._fetch_all_sizes()
+            with self._sizes_lock:
+                if self._sizes is None:
+                    self._sizes = self._fetch_all_sizes()
         return self._sizes[np.asarray(indices, np.int64)]
 
     def _fetch_all_sizes(self) -> np.ndarray:
         out = np.zeros((self.total, 2), np.int64)
-        with self._lock:
-            for rank, (host, port, s0, s1) in enumerate(self.peers):
-                if s0 == self.start and s1 == self.stop:
-                    out[s0:s1] = self.ds.sample_sizes(range(s1 - s0))
-                    continue
-                sock = self._conn(rank, host, port)
-                buf = io.BytesIO()
-                np.savez(buf, idx=np.zeros((0,), np.int64),
-                         range=np.asarray([s0, s1], np.int64),
-                         sizes=np.asarray(1, np.int64))
-                _send_msg(sock, buf.getvalue())
-                with np.load(io.BytesIO(_recv_msg(sock)),
-                             allow_pickle=False) as z:
-                    if int(z["n"]) < 0:
-                        raise RuntimeError(
-                            f"size-table fetch misrouted at {host}:{port} "
-                            f"(expected range [{s0}, {s1}))"
-                        )
-                    out[s0:s1] = z["sizes"]
+        for rank, (host, port, s0, s1) in enumerate(self.peers):
+            if s0 == self.start and s1 == self.stop:
+                out[s0:s1] = self.ds.sample_sizes(range(s1 - s0))
+                continue
+            z = _unpack_arrays(self._request(
+                rank, host, port,
+                idx=np.zeros((0,), np.int64),
+                range=np.asarray([s0, s1], np.int64),
+                sizes=np.asarray(1, np.int64),
+            ))
+            self._check_status(z, host, port, s0, s1)
+            out[s0:s1] = z["sizes"]
         return out
 
     def fetch(self, indices) -> list[GraphSample]:
         """Batched read of arbitrary GLOBAL indices: local ones from mmap,
-        remote ones with ONE request per owning host."""
+        remote ones with ONE request per owning host. Only the cache
+        bookkeeping is serialized; the network round-trips run on pooled
+        per-call sockets, so concurrent callers (PrefetchLoader workers)
+        overlap their remote fetches."""
         out: dict[int, GraphSample] = {}
         by_owner: dict[int, list[int]] = {}
-        with self._lock:
-            for i in map(int, indices):
-                if self.start <= i < self.stop:
-                    out[i] = self.ds[i - self.start]
-                elif i in self._cache:
-                    self._cache.move_to_end(i)
-                    out[i] = self._cache[i]
-                else:
-                    rank = self._owner(i)[0]
-                    by_owner.setdefault(rank, []).append(i)
-            for rank, idxs in by_owner.items():
-                host, port, s0, s1 = self.peers[rank]
-                sock = self._conn(rank, host, port)
-                buf = io.BytesIO()
-                np.savez(buf, idx=np.asarray([i - s0 for i in idxs], np.int64),
-                         range=np.asarray([s0, s1], np.int64))
-                _send_msg(sock, buf.getvalue())
-                payload = _recv_msg(sock)
-                with np.load(io.BytesIO(payload), allow_pickle=False) as z:
-                    if int(z["n"]) < 0:
-                        have = z["have"] if "have" in z.files else "?"
-                        raise RuntimeError(
-                            f"shard fetch misrouted: peer at {host}:{port} "
-                            f"owns global range {have}, expected [{s0}, {s1})"
-                            " — check the advertised addresses (loopback "
-                            "hostnames on multi-host clusters are the usual "
-                            "cause; pass advertise_host explicitly)"
-                        )
-                samples = _decode_samples(payload)
+        remote: list[int] = []
+        for i in map(int, indices):
+            if self.start <= i < self.stop:
+                out[i] = self.ds[i - self.start]  # zero-copy mmap read
+            else:
+                remote.append(i)
+        if remote:
+            pending: set[int] = set()
+            with self._lock:
+                for i in remote:
+                    if i in self._cache:
+                        self._cache.move_to_end(i)
+                        out[i] = self._cache[i]
+                    elif i not in pending:
+                        pending.add(i)
+                        rank = self._owner(i)[0]
+                        by_owner.setdefault(rank, []).append(i)
+        def fetch_owner(item):
+            rank, idxs = item
+            host, port, s0, s1 = self.peers[rank]
+            z = _unpack_arrays(self._request(
+                rank, host, port,
+                idx=np.asarray([i - s0 for i in idxs], np.int64),
+                range=np.asarray([s0, s1], np.int64),
+            ))
+            self._check_status(z, host, port, s0, s1)
+            return idxs, _samples_from_frame(z)
+
+        if len(by_owner) <= 1:
+            results = [fetch_owner(it) for it in by_owner.items()]
+        else:
+            # a shuffled global batch touches many owners — issue those
+            # round-trips concurrently instead of paying one RTT per owner
+            with ThreadPoolExecutor(min(len(by_owner), 16)) as ex:
+                results = list(ex.map(fetch_owner, by_owner.items()))
+        for idxs, samples in results:
+            with self._lock:
                 self.remote_fetches += len(samples)
                 for i, s in zip(idxs, samples):
                     out[i] = s
@@ -392,13 +630,7 @@ class ShardedStore:
 
     def close(self) -> None:
         self.server.close()
-        with self._lock:
-            for sock in self._conns.values():
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-            self._conns.clear()
+        self._pool.close()
 
 
 def _ip_to_int(ip: str) -> int:

@@ -350,6 +350,131 @@ def test_sharded_store_serves_remote_samples(tmp_path):
         s1.close()
 
 
+def test_sharded_store_auth_token_and_bind_host(tmp_path):
+    """Round-4 advisor finding: the shard server can bind a specific
+    interface and reject peers without the shared token — a wrong token
+    fails LOUDLY, a matching one serves normally."""
+    import numpy as np
+    import pytest
+
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+
+    samples = deterministic_graph_data(number_configurations=12, seed=3)
+    p0, p1 = str(tmp_path / "a.gpk"), str(tmp_path / "b.gpk")
+    PackedWriter(samples[:6], p0)
+    PackedWriter(samples[6:], p1)
+    srv = ShardedStore(p1, 6, 12,
+                       peers=[("127.0.0.1", 0, 0, 6), ("127.0.0.1", 0, 6, 12)],
+                       bind_host="127.0.0.1", auth_token="s3cret")
+    peers = [("127.0.0.1", 0, 0, 6),
+             ("127.0.0.1", srv.server.port, 6, 12)]
+    bad = ShardedStore(p0, 0, 6, peers=peers, auth_token="wrong")
+    good = ShardedStore(p0, 0, 6, peers=peers, auth_token="s3cret")
+    try:
+        with pytest.raises(RuntimeError, match="auth token"):
+            bad[8]
+        s = good[8]
+        np.testing.assert_array_equal(np.asarray(s.x), np.asarray(samples[8].x))
+    finally:
+        bad.close()
+        good.close()
+        srv.close()
+
+
+def test_sharded_store_concurrent_fetch_overlap(tmp_path):
+    """The connection pool must let concurrent fetches overlap their network
+    waits (round-4 verdict item 2): with a 120ms per-request server delay,
+    4 threads fetching 8 disjoint remote samples must beat the sequential
+    path by >=2x. Deterministic: the injected delay dominates all noise."""
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+
+    samples = deterministic_graph_data(number_configurations=24, seed=8)
+    p0, p1 = str(tmp_path / "a.gpk"), str(tmp_path / "b.gpk")
+    PackedWriter(samples[:4], p0)
+    PackedWriter(samples[4:], p1)
+    srv = ShardedStore(p1, 4, 24,
+                       peers=[("127.0.0.1", 0, 0, 4), ("127.0.0.1", 0, 4, 24)],
+                       _test_delay_s=0.12)
+    s0 = ShardedStore(
+        p0, 0, 4,
+        peers=[("127.0.0.1", 0, 0, 4),
+               ("127.0.0.1", srv.server.port, 4, 24)],
+    )
+    try:
+        t0 = time.perf_counter()
+        for i in range(4, 12):
+            s0.fetch([i])
+        t_seq = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(4) as ex:
+            list(ex.map(lambda i: s0.fetch([i]), range(12, 20)))
+        t_conc = time.perf_counter() - t0
+        assert t_seq / t_conc >= 2.0, (
+            f"overlap speedup {t_seq / t_conc:.2f} < 2 "
+            f"(seq {t_seq:.2f}s, conc {t_conc:.2f}s)"
+        )
+        # pooled sockets were returned, capped at the idle limit
+        idle = s0._pool._idle.get(1, [])
+        assert 1 <= len(idle) <= 4
+    finally:
+        s0.close()
+        srv.close()
+
+
+def test_sharded_store_multi_owner_fetch_and_stale_socket_retry(tmp_path):
+    """(a) one fetch spanning several owners issues the per-owner requests
+    concurrently and still returns every sample in order; (b) a socket that
+    went stale while parked in the pool (peer/NAT drop) is retried once on
+    a fresh connection instead of crashing the fetch."""
+    import numpy as np
+
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+
+    samples = deterministic_graph_data(number_configurations=18, seed=6)
+    paths = [str(tmp_path / f"s{k}.gpk") for k in range(3)]
+    PackedWriter(samples[:6], paths[0])
+    PackedWriter(samples[6:12], paths[1])
+    PackedWriter(samples[12:], paths[2])
+    spans = [(0, 6), (6, 12), (12, 18)]
+    stores = []
+    for k, (lo, hi) in enumerate(spans):
+        peers = [("127.0.0.1", s.server.port if s else 0, a, b)
+                 for (a, b), s in zip(spans, stores + [None] * (3 - len(stores)))]
+        stores.append(ShardedStore(paths[k], lo, hi, peers=peers, cache_size=2))
+    s0 = stores[0]
+    s0.peers = [("127.0.0.1", st.server.port, a, b)
+                for st, (a, b) in zip(stores, spans)]
+    try:
+        got = s0.fetch(list(range(2, 16)))  # spans all three owners
+        for i, s in zip(range(2, 16), got):
+            np.testing.assert_array_equal(np.asarray(s.x), np.asarray(samples[i].x))
+        # kill every idle pooled socket out from under the store, then
+        # fetch fresh (uncached) indices — the retry must absorb the stale
+        # sockets transparently
+        for stack in s0._pool._idle.values():
+            for sock in stack:
+                sock.close()
+        got = s0.fetch([16, 17, 6])
+        np.testing.assert_array_equal(
+            np.asarray(got[0].x), np.asarray(samples[16].x)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got[2].x), np.asarray(samples[6].x)
+        )
+    finally:
+        for st in stores:
+            st.close()
+
+
 def test_sharded_store_size_table_and_misroute_guard(tmp_path):
     """Round-4 review findings: (a) sample_sizes answers from the exchanged
     size table — zero content fetches for bucket planning; (b) a misrouted
