@@ -325,7 +325,15 @@ def _parse_bracket_atom(body: str, atoms: list) -> int:
         raise ValueError(f"unsupported bracket atom [{body}]")
     sym = m.group("sym")
     arom = sym[0].islower()
-    z = _AROMATIC[sym] if arom else _SYMBOLS[sym.capitalize() if len(sym) > 1 else sym]
+    if arom:
+        if sym not in _AROMATIC:
+            raise ValueError(f"unsupported aromatic atom [{body}]")
+        z = _AROMATIC[sym]
+    else:
+        key = sym.capitalize() if len(sym) > 1 else sym
+        if key not in _SYMBOLS:
+            raise ValueError(f"unsupported element in bracket atom [{body}]")
+        z = _SYMBOLS[key]
     h = 0
     if m.group("hy"):
         h = int(m.group("hy")[1:] or 1)

@@ -213,6 +213,12 @@ def run_hpo(
             if np.isfinite(value) and value < best_value:
                 best_assignment, best_value = assignment, value
         if best_assignment is None:
+            if launched == 0:
+                raise RuntimeError(
+                    "HPO walltime budget expired before any trial completed "
+                    "— increase walltime_budget or shrink per-trial cost "
+                    "(this is a budget misconfiguration, not diverged trials)"
+                )
             raise RuntimeError(
                 f"all {launched} launched HPO trials returned non-finite "
                 f"objectives (history: {[h['value'] for h in history]})"
