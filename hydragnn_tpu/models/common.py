@@ -150,15 +150,38 @@ class MaskedBatchNorm(nn.Module):
 
         if train:
             m = mask.reshape(-1, 1).astype(x.dtype)
-            count = jnp.maximum(m.sum(), 1.0)
-            mean = (x * m).sum(axis=0) / count
-            var = (((x - mean) ** 2) * m).sum(axis=0) / count
+            # count-weighted sums (not per-replica means): SyncBN then psums
+            # raw sums, giving the EXACT union-batch statistics regardless of
+            # per-replica counts — and an ALL-masked replica (a fill batch
+            # padding a partial device group) contributes zero weight
+            # instead of dragging the stats toward 0
+            msum = m.sum()
+            s1 = (x * m).sum(axis=0)
             if self.axis_name is not None:
-                mean = jax.lax.pmean(mean, self.axis_name)
-                var = jax.lax.pmean(var, self.axis_name)
+                msum = jax.lax.psum(msum, self.axis_name)
+                s1 = jax.lax.psum(s1, self.axis_name)
+            count = jnp.maximum(msum, 1.0)
+            mean = s1 / count
+            # second pass centered on the (global) mean: two-pass numerics,
+            # and under SyncBN the psum'd centered sums give the EXACT
+            # union-batch variance (not the mean of per-replica variances)
+            cv = (((x - mean) ** 2) * m).sum(axis=0)
+            if self.axis_name is not None:
+                cv = jax.lax.psum(cv, self.axis_name)
+            var = cv / count
             if not self.is_initializing():
-                ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
-                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+                # EMA gated on real rows: a zero-count batch keeps the old
+                # running stats bit-identical (no decay toward 0)
+                alpha = (1.0 - self.momentum) * (msum > 0)
+                ra_mean.value = ra_mean.value + alpha * (mean - ra_mean.value)
+                ra_var.value = ra_var.value + alpha * (var - ra_var.value)
+            # FORWARD for a zero-count batch (an all-masked fill replica
+            # without SyncBN) uses the running stats: normalizing by
+            # mean=0/var=0 would amplify donor features ~1/sqrt(eps) per
+            # layer, overflowing deep stacks to inf — and inf * mask(0) is
+            # NaN in the loss, poisoning the whole device group's gradients
+            mean = jnp.where(msum > 0, mean, ra_mean.value)
+            var = jnp.where(msum > 0, var, ra_var.value)
         else:
             mean, var = ra_mean.value, ra_var.value
 

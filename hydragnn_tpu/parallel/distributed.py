@@ -93,11 +93,19 @@ def setup_ddp(verbosity: int = 0) -> tuple[int, int]:
     runs (no-op) and idempotent."""
     import jax
 
+    # live jax state FIRST: a caller that already ran
+    # jax.distributed.initialize (tests, notebooks, torchrun-less launches)
+    # has no scheduler env vars, and consulting the env cascade first would
+    # return (1, 0) on EVERY process — each rank then loads the full
+    # dataset (world x duplicated training data) while the SPMD step still
+    # spans the global mesh. is_initialized() is side-effect-free;
+    # process_count() would materialize the XLA backend, which breaks the
+    # jax.distributed.initialize below on scheduler-launched ranks.
+    if jax.distributed.is_initialized():
+        return jax.process_count(), jax.process_index()
     world, rank = init_comm_size_and_rank()
     if world <= 1:
         return 1, 0
-    if jax.process_count() > 1:  # already initialized
-        return jax.process_count(), jax.process_index()
 
     from ..utils import flags
 

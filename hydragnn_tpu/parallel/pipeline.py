@@ -274,11 +274,15 @@ def make_pipelined_forward(
         if not collect_stats:
             return inv, equiv
         # assemble the updated batch_stats pytree: prologue norms from the
-        # vmapped pass (mean over microbatches), ring norms unstacked from
-        # the [L-1, ...] stage-axis output
+        # vmapped pass (node-count-weighted mean over microbatches, so a
+        # fill microbatch padding a trailing group carries zero stat
+        # weight), ring norms unstacked from the [L-1, ...] stage-axis
+        # output
+        from .step import merge_replica_stats
+
         new_stats = dict(stats)
         new_stats.update(
-            jax.tree.map(lambda x: x.mean(axis=0), pro_upd)
+            merge_replica_stats(pro_upd, jax.vmap(lambda b: b.node_mask.sum())(mb))
         )
         if collect_ring:
             for i in range(1, L):

@@ -128,6 +128,27 @@ def put_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
     return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), data_sh), batch)
 
 
+def merge_replica_stats(new_stats, node_counts):
+    """Replica-mean merge of per-replica batch_stats updates, EXCLUDING
+    replicas that saw zero real nodes. A plain mean would hand a FILL
+    replica (all-masked batch padding a trailing device group — its norms
+    keep their old running stats) weight n_fill/n_dev, diluting the real
+    batches' EMA step. Weights are binary (count > 0), not proportional:
+    real replicas keep the reference's equal-replica-mean semantics (and
+    the pipeline ring-norm accumulation matches it bit-for-bit); fill
+    replicas get exactly zero. Under SyncBN every replica already holds
+    identical (union) stats, so the weighted mean reduces to the same
+    value."""
+    w = (node_counts > 0).astype(jnp.float32)
+    tot = jnp.maximum(w.sum(), 1.0)
+
+    def merge(x):
+        wb = w.reshape((-1,) + (1,) * (x.ndim - 1))
+        return (x * wb).sum(axis=0) / tot
+
+    return jax.tree.map(merge, new_stats)
+
+
 def make_parallel_train_step(
     model: HydraModel, optimizer, mesh: Mesh, compute_dtype=jnp.float32
 ):
@@ -156,13 +177,17 @@ def make_parallel_train_step(
             pred = _cast_floats(outputs, jnp.float32)
             tot, tasks = model.loss(pred, b)
             ng = b.graph_mask.sum()
-            return tot * ng, jnp.stack(tasks) * ng, ng, updates["batch_stats"]
+            nw = b.node_mask.sum()
+            return tot * ng, jnp.stack(tasks) * ng, ng, nw, updates["batch_stats"]
 
-        tots, tasks, ngs, new_stats = jax.vmap(per_device, axis_name=SYNC_BN_AXIS)(c_batches, dev_rngs)
+        tots, tasks, ngs, nws, new_stats = jax.vmap(
+            per_device, axis_name=SYNC_BN_AXIS
+        )(c_batches, dev_rngs)
         denom = jnp.maximum(ngs.sum(), 1.0)
         loss = tots.sum() / denom
-        # running stats: average replicas (reference default — SyncBatchNorm off)
-        new_stats = jax.tree.map(lambda x: x.mean(axis=0), new_stats)
+        # running stats: node-count-weighted replica merge (reference
+        # default replica averaging, with fill replicas at zero weight)
+        new_stats = merge_replica_stats(new_stats, nws)
         return loss, (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
 
     @partial(jax.jit, donate_argnums=_donate())
@@ -303,11 +328,14 @@ def _make_parallel_mlip_train_step(
             forces = (-grad_pos * b_raw.node_mask[:, None]).astype(jnp.float32)
             tot, tasks = energy_force_loss(spec, graph_e, forces, b_raw)
             ng = b_raw.graph_mask.sum()
-            return tot * ng, jnp.stack(tasks) * ng, ng, new_stats
+            nw = b_raw.node_mask.sum()
+            return tot * ng, jnp.stack(tasks) * ng, ng, nw, new_stats
 
-        tots, tasks, ngs, new_stats = jax.vmap(per_device, axis_name=SYNC_BN_AXIS)(c_batches, batches, dev_rngs)
+        tots, tasks, ngs, nws, new_stats = jax.vmap(
+            per_device, axis_name=SYNC_BN_AXIS
+        )(c_batches, batches, dev_rngs)
         denom = jnp.maximum(ngs.sum(), 1.0)
-        new_stats = jax.tree.map(lambda x: x.mean(axis=0), new_stats)
+        new_stats = merge_replica_stats(new_stats, nws)
         return tots.sum() / denom, (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
 
     @partial(jax.jit, donate_argnums=_donate())
