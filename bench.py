@@ -254,7 +254,9 @@ def _run_workload(
     note("params initialized")
     train_step = make_step(model, optimizer)
 
+    t_c = time.perf_counter()
     state, _ = _time_steps(train_step, state, batches, warmup)
+    compile_s = time.perf_counter() - t_c
     note("warmup (compile) done")
     profile_dir = os.getenv("BENCH_PROFILE")
     if profile_dir:
@@ -278,6 +280,8 @@ def _run_workload(
         "collate_ms_per_batch": round(1e3 * collate_s / len(host_batches), 3),
         # wasted node slots = pure wasted FLOPs at scale (round-3 verdict #4)
         "padding_waste": round(1.0 - real / max(slots, 1), 4),
+        # warmup wall time ~= XLA compile cost (cache-cold first run)
+        "compile_s": round(compile_s, 2),
     }
     flops = _flops_of(train_step, state, batches[0])
     if flops:
