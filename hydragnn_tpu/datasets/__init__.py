@@ -32,9 +32,20 @@ def load_raw_dataset(config: dict):
         return SimplePickleDataset(path, ds.get("label", "total")).load_all()
     if fmt == "packed":
         return PackedDataset(path).load_all()
+    if fmt in ("adios", "bp"):
+        # reference configs say "format": "adios" — read their .bp store
+        # directly (datasets/convert.read_bp_dataset)
+        from .convert import read_bp_dataset
+
+        return read_bp_dataset(path, label=ds.get("label", "trainset"))
+    if fmt in ("hdf5", "h5"):
+        from .hdf5 import read_hdf5
+
+        return read_hdf5(path, flavor=ds.get("hdf5_flavor", "auto"))
     raise ValueError(
         f"Dataset format '{fmt}' has no registered loader; supported: "
-        "LSMS, XYZ, CFG, pickle, packed (or pass samples= directly)"
+        "LSMS, XYZ, CFG, pickle, packed, adios/bp, hdf5 (or pass samples= "
+        "directly)"
     )
 
 
