@@ -8,6 +8,13 @@ inside ONE compiled program per step — ``lax.scan`` rolls whole trajectory
 segments without the host in the loop.
 
     python examples/md_rollout/md_rollout.py [--epochs 8] [--steps 200]
+
+Large systems use the binned cell list instead of the dense O(N^2) build
+(``--neighbor cell``, automatic at >= 512 atoms). ``--big N`` skips MLIP
+training and rolls an analytic Lennard-Jones lattice of ~N atoms to
+demonstrate 10k+-atom on-device MD throughput:
+
+    python examples/md_rollout/md_rollout.py --big 10000 --steps 100
 """
 
 from __future__ import annotations
@@ -65,6 +72,63 @@ CONFIG = {
 }
 
 
+def run_big_lattice(args) -> None:
+    """Analytic-LJ MD on a periodic cubic lattice of ~args.big atoms: the
+    binned cell list keeps the neighbor rebuild O(N x 27 x cap) in memory,
+    so 10k+ atoms fit where the dense O(N^2) matrix would not."""
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hydragnn_tpu.md import kinetic_energy, run_md, temperature_of
+
+    k = max(2, round(args.big ** (1 / 3)))
+    n = k**3
+    a = 2.2  # lattice spacing (sigma ~ 2.0 -> mildly attractive start)
+    box = k * a
+    cell = np.eye(3) * box
+    pbc = np.array([True, True, True])
+    g = np.stack(np.meshgrid(*([np.arange(k)] * 3), indexing="ij"), -1)
+    rng = np.random.default_rng(0)
+    pos = (g.reshape(-1, 3) * a + a / 2
+           + 0.05 * rng.normal(size=(n, 3))).astype(np.float32)
+    vel = 0.02 * rng.normal(size=(n, 3)).astype(np.float32)
+    cutoff = 3.0
+    # ~30 neighbors/atom at this density, x2 headroom
+    max_edges = int(n * 60)
+
+    def lj(pos_, s_, r_, sh_, em_):
+        d = pos_[r_] - pos_[s_] + sh_
+        d2 = (d * d).sum(-1) + (1.0 - em_)
+        inv6 = (2.0**2 / d2) ** 3
+        return 0.5 * jnp.sum(em_ * 4.0 * 0.02 * (inv6 * inv6 - inv6))
+
+    steps = args.steps - args.steps % args.record_every or args.record_every
+    masses = np.ones(n, np.float32)
+    t0 = time.time()
+    final, traj = run_md(
+        lj, pos, vel, masses, dt=args.dt, n_steps=steps, cutoff=cutoff,
+        max_edges=max_edges, cell=cell, pbc=pbc,
+        record_every=args.record_every,
+        neighbor="cell" if args.neighbor == "auto" else args.neighbor,
+    )
+    dt_wall = time.time() - t0
+    pot = np.asarray(traj.energy)
+    kin = np.array([float(kinetic_energy(v, masses)) for v in traj.vel])
+    tot = pot + kin
+    assert np.all(np.isfinite(tot)), "trajectory diverged"
+    assert int(final.max_n_edges) <= max_edges, "edge buffer overflow"
+    drift = abs(tot[-1] - tot[0]) / max(abs(tot[0]), 1e-9)
+    print(
+        f"big-lattice MD: {steps} steps, {n} atoms (cell list), "
+        f"{1e3 * dt_wall / steps:.1f} ms/step incl. compile, "
+        f"peak neighbors {int(final.max_n_edges)}, "
+        f"T {float(temperature_of(final.vel, masses)):.4f}, "
+        f"total-energy drift {drift:.2e}"
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--epochs", type=int, default=8)
@@ -72,8 +136,16 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--dt", type=float, default=1e-3)
     ap.add_argument("--record-every", type=int, default=20)
+    ap.add_argument("--neighbor", choices=("auto", "dense", "cell"),
+                    default="auto")
+    ap.add_argument("--big", type=int, default=0, metavar="N",
+                    help="analytic-LJ lattice of ~N atoms (no MLIP training)"
+                    " — demonstrates cell-list MD at 10k+ atoms")
     args = ap.parse_args()
     CONFIG["NeuralNetwork"]["Training"]["num_epoch"] = args.epochs
+    if args.big:
+        run_big_lattice(args)
+        return
 
     import jax
     import jax.numpy as jnp
@@ -106,7 +178,7 @@ def main() -> None:
         energy, pos0, vel0, jnp.ones((n,)), dt=args.dt, n_steps=steps,
         cutoff=float(CONFIG["NeuralNetwork"]["Architecture"]["radius"]),
         max_edges=max_edges, record_every=args.record_every,
-        pad_id=pad.n_node - 1,
+        pad_id=pad.n_node - 1, neighbor=args.neighbor,
     )
     pot = np.asarray(traj.energy)
     kin = np.array([float(kinetic_energy(v, jnp.ones((n,)))) for v in traj.vel])
