@@ -186,3 +186,15 @@ def test_example_md_rollout():
     )
     assert "MD rollout: 60 steps on-device" in out
     assert "total-energy drift" in out
+
+
+def test_example_md_rollout_big_lattice():
+    """The --big mode: analytic-LJ lattice on the binned cell list (CI-sized
+    here; same code path as the 10k-atom demo)."""
+    out = run_example(
+        ["examples/md_rollout/md_rollout.py", "--big", "600", "--steps",
+         "30", "--record-every", "10"],
+        timeout=600,
+    )
+    assert "cell list" in out
+    assert "total-energy drift" in out
