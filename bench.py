@@ -361,15 +361,16 @@ def bench_loader(batch_size: int) -> dict:
 # server would share the client's GIL and misreport the overlap the pool
 # buys (the real deployment is always cross-process/cross-host).
 _SHARD_SERVER_SCRIPT = """
-import sys, time
+import os, sys, time
 from hydragnn_tpu.datasets.sharded import ShardedStore
 path, start, stop = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
 srv = ShardedStore(path, start, stop,
                    peers=[("127.0.0.1", 0, 0, start),
                           ("127.0.0.1", 0, start, stop)])
 print(srv.server.port, flush=True)
-while True:
-    time.sleep(60)
+ppid = os.getppid()
+while os.getppid() == ppid:  # exit when the bench child dies (even SIGKILL)
+    time.sleep(2)
 """
 
 
@@ -399,6 +400,13 @@ def bench_sharded(n_samples: int = 512, batch: int = 32) -> dict:
              str(n_samples)],
             stdout=subprocess.PIPE, text=True,
         )
+        # bounded wait: a wedged server must fail THIS row, not eat the
+        # whole window before the headline rows run
+        import select
+
+        ready, _, _ = select.select([srv_proc.stdout], [], [], 120)
+        if not ready:
+            raise RuntimeError("shard server subprocess did not start in 120s")
         port = int(srv_proc.stdout.readline())
         s0 = ShardedStore(
             p0, 0, half, cache_size=1,  # cache off: measure the wire

@@ -49,7 +49,9 @@ def _sample(z, pos, energy=None, forces=None) -> GraphSample:
     kw = {}
     if energy is not None:
         kw["energy_y"] = np.asarray(energy, np.float32).reshape(1)
-        kw["graph_y"] = kw["energy_y"]
+        # own buffer, not a view of energy_y: an in-place edit of one target
+        # must never silently rewrite the other
+        kw["graph_y"] = np.array(kw["energy_y"])
     if forces is not None:
         kw["forces_y"] = np.asarray(forces, np.float32).reshape(-1, 3)
     return GraphSample(x=z, pos=np.asarray(pos, np.float32).reshape(-1, 3), **kw)

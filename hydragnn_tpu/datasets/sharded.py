@@ -188,10 +188,6 @@ def _encode_samples(samples: list[GraphSample]) -> bytes:
     return _pack_arrays(flat)
 
 
-def _decode_samples(payload: bytes) -> list[GraphSample]:
-    return _samples_from_frame(_unpack_arrays(payload))
-
-
 def _samples_from_frame(z: dict[str, np.ndarray]) -> list[GraphSample]:
     n = int(z["n"])
     out = []
@@ -325,6 +321,7 @@ class _ConnPool:
         self._idle: dict[int, list[socket.socket]] = {}
         self._lock = threading.Lock()
         self._max_idle = int(max_idle_per_peer)
+        self._closed = False
 
     def acquire(self, rank: int, host: str, port: int) -> tuple[socket.socket, bool]:
         """Returns (socket, from_pool). A pooled socket may have gone stale
@@ -338,10 +335,13 @@ class _ConnPool:
 
     def release(self, rank: int, sock: socket.socket) -> None:
         with self._lock:
-            stack = self._idle.setdefault(rank, [])
-            if len(stack) < self._max_idle:
-                stack.append(sock)
-                return
+            # a release racing close() (in-flight fetch during teardown)
+            # must not re-park into the cleared pool — close the socket
+            if not self._closed:
+                stack = self._idle.setdefault(rank, [])
+                if len(stack) < self._max_idle:
+                    stack.append(sock)
+                    return
         try:
             sock.close()
         except OSError:
@@ -349,6 +349,7 @@ class _ConnPool:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             for stack in self._idle.values():
                 for sock in stack:
                     try:
@@ -408,6 +409,7 @@ class ShardedStore:
         self._cache_size = int(cache_size)
         self._sizes: np.ndarray | None = None  # lazy global size table
         self._sizes_lock = threading.Lock()
+        self._executor: ThreadPoolExecutor | None = None  # lazy, persistent
         self.remote_fetches = 0  # telemetry: audited by tests/bench
 
     def _allgather_peers(self, advertise_host: str | None):
@@ -563,9 +565,17 @@ class ShardedStore:
             results = [fetch_owner(it) for it in by_owner.items()]
         else:
             # a shuffled global batch touches many owners — issue those
-            # round-trips concurrently instead of paying one RTT per owner
-            with ThreadPoolExecutor(min(len(by_owner), 16)) as ex:
-                results = list(ex.map(fetch_owner, by_owner.items()))
+            # round-trips concurrently instead of paying one RTT per owner.
+            # The executor is persistent (created once, closed with the
+            # store): per-batch spawn/teardown would burn host CPU in the
+            # hot path it exists to hide.
+            if self._executor is None:
+                with self._lock:
+                    if self._executor is None:
+                        self._executor = ThreadPoolExecutor(
+                            min(len(self.peers), 16)
+                        )
+            results = list(self._executor.map(fetch_owner, by_owner.items()))
         for idxs, samples in results:
             with self._lock:
                 self.remote_fetches += len(samples)
@@ -630,6 +640,8 @@ class ShardedStore:
 
     def close(self) -> None:
         self.server.close()
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
         self._pool.close()
 
 

@@ -204,7 +204,6 @@ def binned_radius_graph(
     # min-image displacement, identical formula to the dense builder
     pos_pad = jnp.concatenate([pos, jnp.zeros((1, 3), pos.dtype)])
     disp = pos_pad[cand] - pos[:, None, :]  # [n, C, 3]
-    shift = jnp.zeros_like(disp)
     wrap = jnp.round(disp @ inv) * jnp.where(pbc_b, 1.0, 0.0)
     shift = -(wrap @ cellm)
     disp = disp + shift
