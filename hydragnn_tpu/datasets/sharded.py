@@ -572,9 +572,11 @@ class ShardedStore:
             if self._executor is None:
                 with self._lock:
                     if self._executor is None:
-                        self._executor = ThreadPoolExecutor(
-                            min(len(self.peers), 16)
-                        )
+                        # sized for CONCURRENT callers, not one fetch: N
+                        # prefetch workers each fanning out to several
+                        # owners share this pool, so a peers-count cap
+                        # would serialize them against each other
+                        self._executor = ThreadPoolExecutor(16)
             results = list(self._executor.map(fetch_owner, by_owner.items()))
         for idxs, samples in results:
             with self._lock:

@@ -470,67 +470,56 @@ def make_berendsen_npt_step(
     pbc_arr = (jnp.ones(3, bool) if pbc is None
                else jnp.asarray(_np.asarray(pbc), bool).reshape(3))
 
-    def rebuild(pos, cell):
-        return dynamic_radius_graph(
+    def energy_virial(pos, cell):
+        """Rebuild + energy + forces + strain derivative, ONE backward
+        pass — the single home of the virial formula for init and step."""
+        s_, r_, sh, em, ne = dynamic_radius_graph(
             pos, cutoff, max_edges, cell=cell, pbc=pbc_arr, pad_id=pad_id
         )
-
-    def measure(pos, vel, cell, n_prev_max):
-        """Energy, forces, virial, instantaneous T and P at (pos, cell)."""
-        s_, r_, sh, em, ne = rebuild(pos, cell)
 
         def u_of(pos_, eps):
             sc = 1.0 + eps
             return energy_fn(sc * pos_, s_, r_, sc * sh, em)
 
         e, (gpos, geps) = jax.value_and_grad(u_of, argnums=(0, 1))(pos, 0.0)
-        forces = -gpos
-        n = pos.shape[0]
-        ke = 0.5 * jnp.sum(m * vel * vel)
-        t_inst = 2.0 * ke / (3.0 * n)
+        return e, -gpos, geps, ne
+
+    def t_and_p(vel, geps, cell):
+        t_inst = temperature_of(vel, m)
         vol = jnp.abs(jnp.linalg.det(cell))
-        p_inst = (2.0 * ke - geps) / (3.0 * vol)
-        return e, forces, t_inst, p_inst, ne, jnp.maximum(n_prev_max, ne)
+        p_inst = (2.0 * kinetic_energy(vel, m) - geps) / (3.0 * vol)
+        return t_inst, p_inst
 
     def init(pos, vel, cell) -> NPTState:
         pos = jnp.asarray(pos)
+        vel = jnp.asarray(vel)
         cell = jnp.asarray(cell, pos.dtype).reshape(3, 3)
-        e, f, t_i, p_i, ne, mx = measure(pos, jnp.asarray(vel), cell,
-                                         jnp.asarray(0))
-        return NPTState(pos=pos, vel=jnp.asarray(vel), forces=f, energy=e,
+        e, f, geps, ne = energy_virial(pos, cell)
+        t_i, p_i = t_and_p(vel, geps, cell)
+        return NPTState(pos=pos, vel=vel, forces=f, energy=e,
                         cell=cell, pressure=p_i, temperature=t_i,
-                        n_edges=ne, max_n_edges=mx)
+                        n_edges=ne, max_n_edges=ne)
 
     @jax.jit
     def step(state: NPTState) -> NPTState:
         vel_half = state.vel + 0.5 * dt * state.forces / m
         pos = _wrap_positions(state.pos + dt * vel_half, state.cell, pbc_arr)
-        s_, r_, sh, em, ne = rebuild(pos, state.cell)
-
-        def u_of(pos_, eps):
-            sc = 1.0 + eps
-            return energy_fn(sc * pos_, s_, r_, sc * sh, em)
-
-        e, (gpos, geps) = jax.value_and_grad(u_of, argnums=(0, 1))(pos, 0.0)
-        forces = -gpos
+        e, forces, geps, ne = energy_virial(pos, state.cell)
         vel = vel_half + 0.5 * dt * forces / m
-
-        n = pos.shape[0]
-        ke = 0.5 * jnp.sum(m * vel * vel)
-        t_inst = 2.0 * ke / (3.0 * n)
-        vol = jnp.abs(jnp.linalg.det(state.cell))
-        p_inst = (2.0 * ke - geps) / (3.0 * vol)
+        t_inst, p_inst = t_and_p(vel, geps, state.cell)
 
         # weak couplings (clipped: the Berendsen stability guard)
         lam = jnp.sqrt(jnp.clip(
             1.0 + dt / tau_t * (temperature / jnp.maximum(t_inst, 1e-12) - 1.0),
             0.81, 1.21,
         ))
+        # clip BEFORE the cube root: a large pressure excursion would make
+        # the bracket negative, and (negative)**(1/3) is NaN — which a
+        # post-hoc clip cannot catch (the whole state would go NaN forever)
         mu = jnp.clip(
-            (1.0 - compressibility * dt / tau_p * (pressure - p_inst))
-            ** (1.0 / 3.0),
-            1.0 - max_scale_step, 1.0 + max_scale_step,
-        )
+            1.0 - compressibility * dt / tau_p * (pressure - p_inst),
+            (1.0 - max_scale_step) ** 3, (1.0 + max_scale_step) ** 3,
+        ) ** (1.0 / 3.0)
         return NPTState(
             pos=pos * mu, vel=vel * lam, forces=forces, energy=e,
             cell=state.cell * mu, pressure=p_inst, temperature=t_inst,
