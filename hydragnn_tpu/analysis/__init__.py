@@ -1,0 +1,29 @@
+"""``hydragnn_tpu.analysis`` — JAX/TPU-aware static analysis + recompile
+sentinel (graftlint).
+
+Static side: ``python -m hydragnn_tpu.analysis [paths] [--fail-on-new]``
+runs AST rules GL001-GL007 (host syncs reachable from jit, traced-value
+branching, jit-in-loop retraces, static/donate argnum mismatches, unordered
+dict pytrees, donated-buffer reuse, mutable-default / cache-aliased state)
+over the package with a shared whole-package symbol-resolution pass.
+Grandfathered findings live in ``baseline.json`` with per-entry reasons.
+
+Runtime side: :func:`no_recompile` / the ``compile_sentinel`` pytest fixture
+assert a region triggers no more jit cache misses than declared, via
+``jax.monitoring`` counters.
+
+See ``hydragnn_tpu/analysis/README.md`` for the rule catalogue.
+"""
+
+from .core import Finding, analyze, load_baseline, split_new
+from .sentinel import RecompileError, compile_counts, no_recompile
+
+__all__ = [
+    "Finding",
+    "analyze",
+    "load_baseline",
+    "split_new",
+    "RecompileError",
+    "compile_counts",
+    "no_recompile",
+]

@@ -1,0 +1,116 @@
+"""CLI: ``python -m hydragnn_tpu.analysis [paths...]``.
+
+Exit codes: 0 — no findings beyond the baseline; 1 — new findings (printed);
+2 — usage / baseline-format error. ``--fail-on-new`` is the CI entry point:
+identical semantics, quieter output (new findings only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (
+    DEFAULT_BASELINE,
+    BaselineError,
+    analyze,
+    load_baseline,
+    split_new,
+    write_baseline,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.analysis",
+        description="graftlint: JAX/TPU-aware static analysis "
+        "(rules GL001-GL007; see hydragnn_tpu/analysis/README.md)",
+    )
+    ap.add_argument("paths", nargs="*", default=None, help="files/dirs to scan "
+                    "(default: the hydragnn_tpu package)")
+    ap.add_argument("--rules", help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding counts as new")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="CI mode: print only NEW findings, exit non-zero if any")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write current findings to PATH as a baseline "
+                    "(reasons stamped UNREVIEWED; justify each before committing)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--no-suppress", action="store_true",
+                    help="ignore '# graftlint: disable=' comments")
+    args = ap.parse_args(argv)
+
+    paths = args.paths
+    if not paths:
+        import hydragnn_tpu
+
+        paths = list(hydragnn_tpu.__path__)
+    rule_ids = [r.strip() for r in args.rules.split(",")] if args.rules else None
+
+    try:
+        findings = analyze(
+            paths, rule_ids=rule_ids,
+            respect_suppressions=not args.no_suppress,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(
+            args.write_baseline, findings,
+            reason="UNREVIEWED: emitted by --write-baseline; replace with a "
+            "per-finding justification before committing",
+        )
+        print(f"wrote {len(findings)} finding(s) to {args.write_baseline}")
+        return 0
+
+    entries = []
+    if not args.no_baseline:
+        try:
+            entries = load_baseline(args.baseline)
+        except FileNotFoundError:
+            # only the (possibly never-written) DEFAULT baseline may be
+            # absent; an explicit --baseline that doesn't exist is a typo
+            # that would otherwise silently ignore the configured baseline
+            if args.baseline != DEFAULT_BASELINE:
+                print(
+                    f"baseline error: {args.baseline!r} does not exist",
+                    file=sys.stderr,
+                )
+                return 2
+        except BaselineError as e:
+            print(f"baseline error: {e}", file=sys.stderr)
+            return 2
+    new, baselined = split_new(findings, entries)
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "new": [f.to_json() for f in new],
+                "baselined": [f.to_json() for f in baselined],
+            },
+            indent=2,
+        ))
+    else:
+        for f in new:
+            print(f.format())
+        if not args.fail_on_new:
+            for f in baselined:
+                print(f"{f.format()}  [baselined]")
+        status = (
+            f"{len(new)} new finding(s), {len(baselined)} baselined"
+            if entries or not args.no_baseline
+            else f"{len(new)} finding(s)"
+        )
+        print(("FAIL: " if new else "OK: ") + status, file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""graftlint core: finding model, suppression comments, baseline, runner.
+
+The runner builds ONE :class:`~hydragnn_tpu.analysis.symbols.PackageIndex`
+over every collected file (so cross-module decorator/call resolution sees the
+whole package even when rules are then applied file-by-file), computes the
+jit-reachability set once, and applies each enabled rule per module.
+
+Baselines pin *grandfathered* findings: entries match on
+``(rule, path, whitespace-normalized snippet)`` rather than line numbers, so
+unrelated edits above a finding don't invalidate the baseline. Every entry
+carries a human ``reason`` — the tool refuses baselines with empty reasons,
+keeping "we looked at this and it is acceptable because ..." auditable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from dataclasses import dataclass
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<scope>-next|-file)?=(?P<ids>(?:GL\d{3}|all)(?:\s*,\s*(?:GL\d{3}|all))*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # package-relative posix path (or basename for loose files)
+    line: int
+    col: int
+    message: str
+    snippet: str
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, " ".join(self.snippet.split()))
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def parse_suppressions(lines: list[str]) -> tuple[set[str], dict[int, set[str]]]:
+    """-> (file-wide disabled rule ids, {1-based line -> disabled ids}).
+
+    ``# graftlint: disable=GL001`` silences the ids on its own line,
+    ``disable-next=`` the following line, ``disable-file=`` (first 10 lines)
+    the whole file. ``disable=all`` is accepted in every scope.
+    """
+    file_wide: set[str] = set()
+    per_line: dict[int, set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = {s.strip() for s in m.group("ids").split(",") if s.strip()}
+        scope = m.group("scope")
+        if scope == "-file":
+            if i <= 10:
+                file_wide |= ids
+        elif scope == "-next":
+            per_line.setdefault(i + 1, set()).update(ids)
+        else:
+            per_line.setdefault(i, set()).update(ids)
+    return file_wide, per_line
+
+
+def is_suppressed(
+    finding: Finding, file_wide: set[str], per_line: dict[int, set[str]]
+) -> bool:
+    ids = per_line.get(finding.line, set()) | file_wide
+    return finding.rule in ids or "all" in ids
+
+
+# -- baseline ----------------------------------------------------------------
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: str) -> list[dict]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data.get("findings", [])
+    for e in entries:
+        missing = {"rule", "path", "snippet"} - set(e)
+        if missing:
+            raise BaselineError(f"baseline entry {e!r} lacks {sorted(missing)}")
+        reason = str(e.get("reason", "")).strip()
+        if not reason:
+            raise BaselineError(
+                f"baseline entry for {e['path']} ({e['rule']}) has no reason; "
+                "every grandfathered finding must say WHY it is acceptable"
+            )
+        if reason.startswith("UNREVIEWED"):
+            # --write-baseline stamps this placeholder; committing it
+            # unedited would make the reason requirement decorative
+            raise BaselineError(
+                f"baseline entry for {e['path']} ({e['rule']}) still carries "
+                "the UNREVIEWED placeholder; replace it with a per-finding "
+                "justification"
+            )
+    return entries
+
+
+def split_new(
+    findings: list[Finding], entries: list[dict]
+) -> tuple[list[Finding], list[Finding]]:
+    """-> (new findings, baselined findings).
+
+    Matching is counted per fingerprint: an entry grandfathers ``count``
+    (default 1) occurrences of its (rule, path, snippet); a SECOND
+    identical-text violation added later in the same file is new, not
+    covered by the first one's baseline entry."""
+    budget: dict[tuple[str, str, str], int] = {}
+    for e in entries:
+        fp = (e["rule"], e["path"], " ".join(str(e["snippet"]).split()))
+        budget[fp] = budget.get(fp, 0) + int(e.get("count", 1))
+    new, old = [], []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(path: str, findings: list[Finding], reason: str) -> None:
+    counts: dict[tuple, int] = {}
+    order: list[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        fp = f.fingerprint()
+        counts[fp] = counts.get(fp, 0) + 1
+        if counts[fp] == 1:
+            order.append(f)
+    entries = []
+    for f in order:
+        e = {
+            "rule": f.rule,
+            "path": f.path,
+            "snippet": " ".join(f.snippet.split()),
+            "reason": reason,
+        }
+        if counts[f.fingerprint()] > 1:
+            e["count"] = counts[f.fingerprint()]
+        entries.append(e)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+# -- runner ------------------------------------------------------------------
+
+
+def collect_files(paths: list[str]) -> list[str]:
+    """Every .py under ``paths``. A path that contributes NOTHING — missing,
+    or existing but matching no .py file — is a usage error: a typo'd CI
+    invocation scanning zero files would otherwise exit 0 green forever."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            n_before = len(out)
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", "node_modules", "venv")
+                    and not d.startswith(".")  # .git, .venv, .tox, ...
+                )
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files) if f.endswith(".py")
+                )
+            if len(out) == n_before:
+                raise ValueError(f"no .py files under directory {p!r}")
+        elif os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        else:
+            raise ValueError(
+                f"path {p!r} is not a .py file or a directory; refusing to "
+                "scan nothing (a typo here would silently disable the gate)"
+            )
+    return out
+
+
+def analyze(
+    paths: list[str],
+    rule_ids: list[str] | None = None,
+    respect_suppressions: bool = True,
+) -> list[Finding]:
+    """Run the enabled rules over every .py under ``paths``."""
+    from .rules import ALL_RULES, RULES_BY_ID, RuleContext
+    from .symbols import PackageIndex
+
+    files = collect_files(paths)
+    index = PackageIndex.build(files)
+    if rule_ids:
+        unknown = [r for r in rule_ids if r not in RULES_BY_ID]
+        if unknown:
+            raise ValueError(f"unknown rule id(s) {unknown}; known: {sorted(RULES_BY_ID)}")
+        rules = [RULES_BY_ID[r] for r in rule_ids]
+    else:
+        rules = ALL_RULES
+    ctx = RuleContext(index=index, jit_contexts=index.jit_contexts())
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    for path in files:
+        mod = index.modules.get(os.path.abspath(path))
+        if mod is None:  # unparsable — surface as a finding, never silent
+            # same package-relative path scheme as every rule finding (a
+            # bare basename would collide in the dedup set when two broken
+            # files share a name, silently dropping one)
+            from .symbols import _module_name_for
+
+            modname, display = _module_name_for(path)
+            if modname is None:  # loose file: basename isn't unique enough
+                display = os.path.relpath(path).replace(os.sep, "/")
+            findings.append(
+                Finding(
+                    rule="GL000",
+                    path=display,
+                    line=1,
+                    col=1,
+                    message="file could not be parsed; graftlint coverage "
+                    "silently excluding it would be worse than failing",
+                    snippet="",
+                )
+            )
+            continue
+        file_wide, per_line = (
+            parse_suppressions(mod.lines) if respect_suppressions else (set(), {})
+        )
+        for rule in rules:
+            for f in rule.check(mod, index, ctx):
+                key = (f.rule, f.path, f.line, f.col, f.message)
+                if key in seen:
+                    continue
+                seen.add(key)
+                if not is_suppressed(f, file_wide, per_line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

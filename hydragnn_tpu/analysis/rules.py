@@ -1,0 +1,724 @@
+"""graftlint rules GL001-GL007 — JAX/TPU hazards the generic linters miss.
+
+Each rule is a class with ``id``, ``title`` and a ``check(mod, index, ctx)``
+returning :class:`~hydragnn_tpu.analysis.core.Finding`s. GL001/GL002 consume
+the precomputed jit-reachability set (``ctx.jit_contexts``) from the shared
+symbol pass; the rest scan module ASTs directly. See ``README.md`` in this
+package for the bad/good example of every rule and the suppression syntax.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import Finding
+from .symbols import JIT_WRAPPERS, FunctionInfo, JitContext, ModuleInfo, PackageIndex
+
+
+@dataclass
+class RuleContext:
+    """Shared, precomputed state handed to every rule."""
+
+    index: PackageIndex
+    jit_contexts: list[JitContext] = field(default_factory=list)
+
+
+def _finding(rule: str, mod: ModuleInfo, node: ast.AST, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    snippet = mod.lines[line - 1].strip() if 0 < line <= len(mod.lines) else ""
+    return Finding(
+        rule=rule,
+        path=mod.display_path,
+        line=line,
+        col=getattr(node, "col_offset", 0) + 1,
+        message=message,
+        snippet=snippet,
+    )
+
+
+# ---------------------------------------------------------------------------
+# traced-name analysis shared by GL001/GL002
+
+#: attribute reads that are trace-time static on a traced array
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding", "itemsize"}
+#: builtins whose result on a traced value is still static/safe to branch on
+_STATIC_CALLS = {"isinstance", "hasattr", "getattr", "callable", "len", "type"}
+
+
+def _traced_name_uses(
+    expr: ast.expr, traced: set[str]
+) -> list[ast.Name]:
+    """Name nodes inside ``expr`` that read a traced value *as a value* —
+    skipping static-attribute access (``x.shape``...), ``x is None`` tests
+    and introspection calls (``isinstance(x, ...)``...)."""
+    out: list[ast.Name] = []
+
+    def walk(node: ast.expr) -> None:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return  # x.shape[0] is static however deep x is traced
+            walk(node.value)
+            return
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) else None
+            if fname in _STATIC_CALLS:
+                return
+            for child in [node.func, *node.args]:
+                walk(child)
+            for kw in node.keywords:
+                walk(kw.value)
+            return
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None`: an identity test, never traced
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return
+            walk(node.left)
+            for c in node.comparators:
+                walk(c)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load) and node.id in traced:
+                out.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                walk(child)
+
+    walk(expr)
+    return out
+
+
+def _local_traced_names(fn: FunctionInfo) -> set[str]:
+    """Traced params plus locals assigned *from* traced values (one
+    propagation pass, no fixpoint — enough to catch `y = x * 2; if y:`)."""
+    traced = set(fn.traced_params())
+    for stmt in ast.walk(fn.node):
+        if isinstance(stmt, ast.Assign) and _traced_name_uses(stmt.value, traced):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    traced.add(t.id)
+        elif isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Name):
+            if _traced_name_uses(stmt.value, traced) or stmt.target.id in traced:
+                traced.add(stmt.target.id)
+    return traced
+
+
+def _iter_body_nodes(fn: FunctionInfo):
+    """Walk the function body, skipping nested defs that are themselves jit
+    roots (they get their own JitContext — avoids duplicate findings)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{fn.qualname}.{node.name}"
+            nested = fn.module.functions.get(qual)
+            if nested is not None and nested.jit is not None:
+                continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+
+
+class GL001HostSync:
+    id = "GL001"
+    title = "host-device sync inside jit-traced code"
+
+    #: method calls that force a device->host transfer / blocking sync
+    SYNC_METHODS = {"item", "tolist", "block_until_ready", "numpy"}
+    #: dotted calls that materialize a traced value on host
+    SYNC_CALLS = {
+        "numpy.asarray",
+        "numpy.array",
+        "numpy.copy",
+        "jax.device_get",
+    }
+    #: builtins that concretize a traced array (ConcretizationTypeError on
+    #: abstract values, silent sync under `jit(..., abstracted_axes)`/eager)
+    SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        for jc in ctx.jit_contexts:
+            fn = jc.fn
+            if fn.module is not mod:
+                continue
+            traced = _local_traced_names(fn)
+            where = (
+                "a jit-traced function"
+                if jc.depth == 0
+                else f"a helper {jc.reason}"
+            )
+            for node in _iter_body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in self.SYNC_METHODS
+                ):
+                    out.append(
+                        _finding(
+                            self.id,
+                            mod,
+                            node,
+                            f".{func.attr}() forces a host-device sync "
+                            f"inside {where}; compute on-device and pull "
+                            "values out AFTER the step returns",
+                        )
+                    )
+                    continue
+                dotted = mod.resolve_dotted(func)
+                if dotted in self.SYNC_CALLS:
+                    out.append(
+                        _finding(
+                            self.id,
+                            mod,
+                            node,
+                            f"{dotted}() materializes a traced value on "
+                            f"host inside {where}; use jnp on-device or "
+                            "move the conversion outside the traced region",
+                        )
+                    )
+                    continue
+                if (
+                    isinstance(func, ast.Name)
+                    and func.id in self.SYNC_BUILTINS
+                    and node.args
+                    and _traced_name_uses(node.args[0], traced)
+                ):
+                    out.append(
+                        _finding(
+                            self.id,
+                            mod,
+                            node,
+                            f"{func.id}() on a traced value inside {where} "
+                            "concretizes it (host sync / trace error); keep "
+                            "it a jax scalar",
+                        )
+                    )
+        return out
+
+
+class GL002TracedBranch:
+    id = "GL002"
+    title = "Python control flow on a traced value"
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        for jc in ctx.jit_contexts:
+            fn = jc.fn
+            if fn.module is not mod:
+                continue
+            traced = _local_traced_names(fn)
+            where = (
+                "a jit-traced function"
+                if jc.depth == 0
+                else "a helper reached from jit"
+            )
+            for node in _iter_body_nodes(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    uses = _traced_name_uses(node.test, traced)
+                    if uses:
+                        kind = "if" if isinstance(node, ast.If) else "while"
+                        names = ", ".join(sorted({u.id for u in uses}))
+                        out.append(
+                            _finding(
+                                self.id,
+                                mod,
+                                node,
+                                f"`{kind}` on traced value(s) {names} inside "
+                                f"{where} raises at trace time (or silently "
+                                "specializes); use jnp.where / lax.cond / "
+                                "lax.while_loop",
+                            )
+                        )
+                elif isinstance(node, ast.IfExp):
+                    uses = _traced_name_uses(node.test, traced)
+                    if uses:
+                        names = ", ".join(sorted({u.id for u in uses}))
+                        out.append(
+                            _finding(
+                                self.id,
+                                mod,
+                                node,
+                                f"conditional expression on traced value(s) "
+                                f"{names} inside {where}; use jnp.where",
+                            )
+                        )
+        return out
+
+
+class GL003JitInLoop:
+    id = "GL003"
+    title = "jax.jit constructed inside a loop"
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        reported: set[int] = set()  # a jit call in a NESTED loop is walked
+        # once per enclosing loop — report it once
+
+        def scan(loop_body: list[ast.stmt]) -> None:
+            for stmt in loop_body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) or id(node) in reported:
+                        continue
+                    dotted = mod.resolve_dotted(node.func)
+                    if dotted in JIT_WRAPPERS:
+                        reported.add(id(node))
+                        out.append(
+                            _finding(
+                                self.id,
+                                mod,
+                                node,
+                                f"{dotted}() inside a loop builds a FRESH "
+                                "jit wrapper (and cache) per iteration — "
+                                "every call retraces; hoist the jit out of "
+                                "the loop",
+                            )
+                        )
+                    elif dotted == "functools.partial" and node.args:
+                        inner = mod.resolve_dotted(node.args[0])
+                        if inner in JIT_WRAPPERS:
+                            reported.add(id(node))
+                            out.append(
+                                _finding(
+                                    self.id,
+                                    mod,
+                                    node,
+                                    "functools.partial(jax.jit, ...) inside "
+                                    "a loop rebuilds the jit per iteration; "
+                                    "hoist it out of the loop",
+                                )
+                            )
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.For, ast.While)):
+                scan(node.body)
+        return out
+
+
+class GL004JitArgSpec:
+    id = "GL004"
+    title = "static/donate argument spec mismatch"
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        seen: set[int] = set()
+        for fi in mod.functions.values():
+            if fi.jit is None or id(fi.jit.node) in seen:
+                continue
+            seen.add(id(fi.jit.node))
+            out.extend(self._check_one(mod, fi, fi.jit))
+        # `name = jax.jit(<unresolvable>, ...)` sites still get the
+        # overlap check through jit_assignments with fn=None
+        for _name, (fn, info) in mod.jit_assignments.items():
+            if id(info.node) in seen:
+                continue
+            seen.add(id(info.node))
+            out.extend(self._check_one(mod, fn, info))
+        return out
+
+    def _check_one(self, mod: ModuleInfo, fn: FunctionInfo | None, info):
+        out = []
+        nums = info.static_argnums or ()
+        donate = info.donate_argnums or ()
+        overlap = sorted(set(nums) & set(donate))
+        if overlap:
+            out.append(
+            _finding(
+                    self.id,
+                    mod,
+                    info.node,
+                    f"argument position(s) {overlap} are BOTH static and "
+                    "donated; a static arg is part of the cache key and "
+                    "cannot be donated",
+                )
+            )
+        if fn is None:
+            return out
+        nparams = len(fn.params)
+        bad = [i for i in nums if i >= nparams or i < -nparams]
+        if bad:
+            out.append(
+                _finding(
+                    self.id,
+                    mod,
+                    info.node,
+                    f"static_argnums {bad} out of range for "
+                    f"{fn.name}() which takes {nparams} parameter(s) — the "
+                    "jit call will fail (or silently bind the wrong arg)",
+                )
+            )
+        if info.static_argnames:
+            unknown = [n for n in info.static_argnames if n not in fn.params]
+            if unknown:
+                out.append(
+                    _finding(
+                        self.id,
+                        mod,
+                        info.node,
+                        f"static_argnames {unknown} name no parameter of "
+                        f"{fn.name}(); jit ignores them and the argument "
+                        "stays traced",
+                    )
+                )
+        # a static arg whose default is an unhashable literal: every call
+        # using the default raises "unhashable type"
+        args = fn.node.args
+        all_args = list(args.posonlyargs) + list(args.args)
+        n_def = len(args.defaults)
+        defaults = [None] * (len(all_args) - n_def) + list(args.defaults)
+        static_names = set(info.static_argnames or ())
+        for i in nums:
+            if -nparams <= i < nparams:
+                static_names.add(fn.params[i])
+        for a, d in zip(all_args, defaults):
+            if a.arg in static_names and isinstance(
+                d, (ast.List, ast.Dict, ast.Set)
+            ):
+                out.append(
+                    _finding(
+                        self.id,
+                        mod,
+                        d,
+                        f"static argument '{a.arg}' of {fn.name}() defaults "
+                        "to an unhashable literal; static args are hashed "
+                        "into the jit cache key — use a tuple / frozen "
+                        "structure",
+                    )
+                )
+        return out
+
+
+class GL005UnorderedPytree:
+    id = "GL005"
+    title = "dict pytree built from an iteration-order-sensitive source"
+
+    _UNORDERED_CALLS = {
+        "os.listdir",
+        "os.scandir",
+        "glob.glob",
+        "glob.iglob",
+    }
+
+    def _unordered_source(self, mod: ModuleInfo, node: ast.expr) -> str | None:
+        """Why iterating ``node`` has no stable order, or None."""
+        if isinstance(node, ast.Set):
+            return "a set literal"
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id in ("set", "frozenset", "vars", "dir"):
+                return f"{node.func.id}()"
+            dotted = mod.resolve_dotted(node.func)
+            if dotted in self._UNORDERED_CALLS:
+                return f"{dotted}() (filesystem order)"
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "iterdir":
+                return ".iterdir() (filesystem order)"
+        if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+            # set algebra: a | b, a & b, a - b
+            l = self._unordered_source(mod, node.left)
+            r = self._unordered_source(mod, node.right)
+            return l or r
+        return None
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        for node in ast.walk(mod.tree):
+            src: ast.expr | None = None
+            kind = ""
+            if isinstance(node, ast.DictComp):
+                src, kind = node.generators[0].iter, "dict comprehension"
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                if node.func.id == "dict" and node.args:
+                    a0 = node.args[0]
+                    if (
+                        isinstance(a0, ast.Call)
+                        and isinstance(a0.func, ast.Name)
+                        and a0.func.id == "zip"
+                        and a0.args
+                    ):
+                        src, kind = a0.args[0], "dict(zip(...))"
+                    elif isinstance(a0, ast.GeneratorExp):
+                        src, kind = a0.generators[0].iter, "dict(<genexp>)"
+            if src is None:
+                continue
+            why = self._unordered_source(mod, src)
+            if why:
+                out.append(
+                    _finding(
+                        self.id,
+                        mod,
+                        node,
+                        f"{kind} iterates {why}: dict pytrees key the jit "
+                        "cache and flatten in insertion order, so an "
+                        "unstable source reorders leaves across processes "
+                        "and retraces/mismatches shards — wrap the source "
+                        "in sorted(...)",
+                    )
+                )
+        return out
+
+
+class GL006DonatedRead:
+    id = "GL006"
+    title = "donated buffer read after the donating call"
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        for fi in mod.functions.values():
+            out.extend(self._check_function(mod, fi))
+        return out
+
+    def _check_function(self, mod: ModuleInfo, fi: FunctionInfo):
+        out = []
+        # donated name -> line of the donating call
+        donated: dict[str, int] = {}
+
+        def donating_info(call: ast.Call):
+            if isinstance(call.func, ast.Name):
+                entry = mod.jit_assignments.get(call.func.id)
+                if entry is not None and entry[1].donate_argnums:
+                    return entry[1].donate_argnums
+                target = mod.functions.get(call.func.id)
+                if (
+                    target is not None
+                    and target.jit is not None
+                    and target.jit.donate_argnums
+                ):
+                    return target.jit.donate_argnums
+            return None
+
+        def scan_reads(node: ast.AST) -> None:
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in donated
+                ):
+                    out.append(
+                        _finding(
+                            self.id,
+                            mod,
+                            sub,
+                            f"'{sub.id}' was donated to the jit call on "
+                            f"line {donated[sub.id]}; its buffer is dead "
+                            "after that call — rebind the result (e.g. "
+                            f"`{sub.id} = step({sub.id}, ...)`) or drop "
+                            "donate_argnums",
+                        )
+                    )
+
+        def clear_bound_targets(stmt: ast.stmt) -> None:
+            targets: list[ast.expr] = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        donated.pop(sub.id, None)
+
+        def mark_donations(stmt: ast.stmt) -> None:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                nums = donating_info(sub)
+                if not nums:
+                    continue
+                for i in nums:
+                    if 0 <= i < len(sub.args) and isinstance(
+                        sub.args[i], ast.Name
+                    ):
+                        donated[sub.args[i].id] = sub.lineno
+
+        def process(stmt: ast.stmt) -> None:
+            """Linear order within a block; recurse into compound bodies.
+            Per simple statement: read-check, THEN mark this statement's
+            donations, THEN clear rebound targets — so the donate-and-
+            rebind idiom `state = step(state, b)` ends with 'state' live."""
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                if donated:
+                    scan_reads(stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) else stmt.test)
+                for s in stmt.body + stmt.orelse:
+                    process(s)
+                return
+            if isinstance(stmt, ast.If):
+                if donated:
+                    scan_reads(stmt.test)
+                # branches are alternatives: check each against the SAME
+                # entry state, merge conservatively (union of donations)
+                snapshot = dict(donated)
+                for s in stmt.body:
+                    process(s)
+                after_body = dict(donated)
+                donated.clear()
+                donated.update(snapshot)
+                for s in stmt.orelse:
+                    process(s)
+                donated.update(after_body)
+                return
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for s in stmt.body:
+                    process(s)
+                return
+            if isinstance(stmt, ast.Try):
+                for s in stmt.body + stmt.orelse + stmt.finalbody:
+                    process(s)
+                for handler in stmt.handlers:
+                    for s in handler.body:
+                        process(s)
+                return
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                return  # nested defs run later; out of linear-scan scope
+            if donated:
+                scan_reads(stmt)
+            mark_donations(stmt)
+            clear_bound_targets(stmt)
+
+        for stmt in fi.node.body:
+            process(stmt)
+        return out
+
+
+class GL007AliasedState:
+    id = "GL007"
+    title = "mutable default / cache-aliased return"
+
+    _MUTABLE_CALLS = {"list", "dict", "set", "OrderedDict", "defaultdict"}
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        for fi in mod.functions.values():
+            args = fi.node.args
+            all_args = list(args.posonlyargs) + list(args.args)
+            n_def = len(args.defaults)
+            defaults = [None] * (len(all_args) - n_def) + list(args.defaults)
+            pairs = list(zip(all_args, defaults)) + list(
+                zip(args.kwonlyargs, args.kw_defaults)
+            )
+            for a, d in pairs:
+                if d is None:
+                    continue
+                bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in self._MUTABLE_CALLS
+                )
+                if bad:
+                    out.append(
+                        _finding(
+                            self.id,
+                            mod,
+                            d,
+                            f"mutable default for '{a.arg}' in {fi.name}() "
+                            "is shared across ALL calls; default to None "
+                            "and create the container in the body",
+                        )
+                    )
+            out.extend(self._check_cache_aliasing(mod, fi))
+        return out
+
+    @staticmethod
+    def _is_cache_store(node: ast.expr) -> bool:
+        """``<...>.X_cache[...]`` / ``self._cache[...]`` style subscripts."""
+        return (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Attribute)
+            and "cache" in node.value.attr.lower()
+        )
+
+    def _check_cache_aliasing(self, mod: ModuleInfo, fi: FunctionInfo):
+        out = []
+        # names assigned INTO a cache subscript in this function
+        cached_names: set[str] = set()
+        for stmt in ast.walk(fi.node):
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if self._is_cache_store(t) and isinstance(
+                        stmt.value, ast.Name
+                    ):
+                        cached_names.add(stmt.value.id)
+                    # also `self._cache[i] = out[i] = s` chains
+                if any(self._is_cache_store(t) for t in stmt.targets):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            cached_names.add(t.id)
+        # two-hop: `out[i] = s` where s is also cached -> `out` aliases the
+        # cache (the ADVICE.md fetch() bug); returning out's elements leaks
+        # cache-resident objects
+        aliased_containers: set[str] = set()
+        for stmt in ast.walk(fi.node):
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Name):
+                if stmt.value.id in cached_names:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name
+                        ):
+                            aliased_containers.add(t.value.id)
+        cached_names |= aliased_containers
+
+        def returned_objects(node: ast.expr):
+            """Sub-expressions the return value aliases: descend through
+            containers/comprehensions/subscripts but NOT into calls — a
+            call result (copy.deepcopy(...), np.array(...)) is presumed to
+            be a fresh object."""
+            stack = [node]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, ast.Call):
+                    continue
+                yield n
+                stack.extend(
+                    c for c in ast.iter_child_nodes(n) if isinstance(c, ast.expr)
+                )
+
+        for stmt in ast.walk(fi.node):
+            if not isinstance(stmt, ast.Return) or stmt.value is None:
+                continue
+            for sub in returned_objects(stmt.value):
+                if self._is_cache_store(sub):
+                    out.append(
+                        _finding(
+                            self.id,
+                            mod,
+                            stmt,
+                            f"{fi.name}() returns an object stored in a "
+                            "cache; a caller mutating it in place corrupts "
+                            "every later cache hit — return a copy",
+                        )
+                    )
+                    break
+                if (
+                    isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)
+                    and sub.id in cached_names
+                ):
+                    out.append(
+                        _finding(
+                            self.id,
+                            mod,
+                            stmt,
+                            f"{fi.name}() returns '{sub.id}' which is ALSO "
+                            "stored in a cache; a caller mutating it in "
+                            "place corrupts every later cache hit — return "
+                            "a copy (keep the cache's instance pristine)",
+                        )
+                    )
+                    break
+        return out
+
+
+ALL_RULES = [
+    GL001HostSync(),
+    GL002TracedBranch(),
+    GL003JitInLoop(),
+    GL004JitArgSpec(),
+    GL005UnorderedPytree(),
+    GL006DonatedRead(),
+    GL007AliasedState(),
+]
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}

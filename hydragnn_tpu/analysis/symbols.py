@@ -1,0 +1,456 @@
+"""Whole-package symbol/decorator resolution for the graftlint rules.
+
+One pass over every scanned file builds a :class:`PackageIndex`:
+
+* per-module import tables (``import jax`` / ``from jax import jit`` /
+  relative package imports), so any callee expression can be resolved to a
+  dotted path like ``jax.jit`` or ``numpy.asarray``;
+* every function/method definition (including nested defs) with its
+  parameters and decorators;
+* every *jit application site* — decorator (``@jax.jit``,
+  ``@functools.partial(jax.jit, ...)``), wrapping assignment
+  (``step = jax.jit(fn, donate_argnums=0)``), or bare call — with the parsed
+  ``static_argnums``/``static_argnames``/``donate_argnums`` options.
+
+From that, :meth:`PackageIndex.jit_contexts` yields every function whose body
+is traced by jit plus, one call level deep, every package-local helper invoked
+from such a body — the reachability set GL001/GL002 scan. The one-level rule
+is deliberate: deeper transitive closure multiplies false positives faster
+than it finds real bugs, and helpers-of-helpers in this codebase are already
+leaf math.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+# Callables whose application makes the wrapped function's body traced.
+JIT_WRAPPERS = {
+    "jax.jit",
+    "jax.pmap",
+    "jax.experimental.pjit.pjit",
+    "jax.pjit",
+}
+
+# Transforms that run their function argument under the CALLER's trace: a
+# helper handed to one of these from a jit-rooted body is itself jit-reachable.
+JIT_TRANSFORMS = {
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.vmap",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.lax.scan",
+    "jax.lax.cond",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.map",
+}
+
+
+@dataclass
+class JitInfo:
+    """Parsed options of one jit application site."""
+
+    node: ast.AST  # the decorator / call expression
+    line: int
+    static_argnums: tuple[int, ...] | None = None  # None = not given/unknown
+    static_argnames: tuple[str, ...] | None = None
+    donate_argnums: tuple[int, ...] | None = None
+    unparsed: bool = False  # options present but not literal
+
+
+@dataclass
+class FunctionInfo:
+    module: "ModuleInfo"
+    qualname: str  # dotted within the module, e.g. "make_train_step.train_step"
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    params: list[str] = field(default_factory=list)
+    # parameter names with a static-looking annotation or constant default —
+    # conventionally trace-time python values, not traced arrays
+    static_like_params: set[str] = field(default_factory=set)
+    jit: JitInfo | None = None
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def path(self) -> str:
+        return self.module.display_path
+
+    def traced_params(self) -> set[str]:
+        """Parameter names plausibly bound to traced arrays inside jit."""
+        out = set(self.params) - self.static_like_params - {"self", "cls"}
+        if self.jit is not None:
+            if self.jit.static_argnums:
+                for i in self.jit.static_argnums:
+                    if 0 <= i < len(self.params):
+                        out.discard(self.params[i])
+            if self.jit.static_argnames:
+                out -= set(self.jit.static_argnames)
+        return out
+
+
+_STATIC_ANNOTATIONS = {"bool", "int", "str", "bytes", "type"}
+
+
+def _is_static_like(arg: ast.arg, default: ast.expr | None) -> bool:
+    ann = arg.annotation
+    if isinstance(ann, ast.Name) and ann.id in _STATIC_ANNOTATIONS:
+        return True
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        # string annotation like "bool"
+        if ann.value.strip() in _STATIC_ANNOTATIONS:
+            return True
+    if default is not None and isinstance(default, ast.Constant):
+        return True
+    return False
+
+
+@dataclass
+class ModuleInfo:
+    path: str  # absolute file path
+    display_path: str  # package-relative posix path used in findings
+    modname: str | None  # dotted module name when inside a package
+    is_package: bool  # an __init__.py (its modname IS the package)
+    tree: ast.Module
+    lines: list[str]
+    # local alias -> dotted module ("np" -> "numpy", "jax" -> "jax")
+    import_aliases: dict[str, str] = field(default_factory=dict)
+    # local name -> dotted target ("jit" -> "jax.jit")
+    from_imports: dict[str, str] = field(default_factory=dict)
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)
+    # assigned-name -> (wrapped FunctionInfo or None, JitInfo) for
+    # `name = jax.jit(fn, ...)` at any nesting level
+    jit_assignments: dict[str, tuple[FunctionInfo | None, JitInfo]] = field(
+        default_factory=dict
+    )
+
+    def resolve_dotted(self, node: ast.expr) -> str | None:
+        """Resolve a Name/Attribute chain to a dotted path using the import
+        tables: ``np.asarray`` -> ``numpy.asarray``, ``jit`` -> ``jax.jit``.
+        Returns None for anything not rooted in an import."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = node.id
+        if base in self.import_aliases:
+            root = self.import_aliases[base]
+        elif base in self.from_imports:
+            root = self.from_imports[base]
+        else:
+            return None
+        return ".".join([root] + list(reversed(parts)))
+
+
+def _module_name_for(path: str) -> tuple[str | None, str]:
+    """(dotted module name, display path). Walk up while __init__.py exists
+    so `.../repo/hydragnn_tpu/train/step.py` maps to
+    ``hydragnn_tpu.train.step`` / ``hydragnn_tpu/train/step.py`` regardless
+    of cwd; standalone files (lint fixtures) fall back to their basename."""
+    path = os.path.abspath(path)
+    d, fname = os.path.split(path)
+    parts = [os.path.splitext(fname)[0]]
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        d, pkg = os.path.split(d)
+        parts.append(pkg)
+    parts.reverse()
+    if len(parts) == 1:
+        return None, fname
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    display = os.path.relpath(path, d).replace(os.sep, "/")
+    return ".".join(parts), display
+
+
+def _int_tuple(node: ast.expr) -> tuple[int, ...] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _str_tuple(node: ast.expr) -> tuple[str, ...] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def parse_jit_options(call: ast.Call | None, anchor: ast.AST) -> JitInfo:
+    info = JitInfo(node=anchor, line=getattr(anchor, "lineno", 0))
+    if call is None:
+        return info
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            info.static_argnums = _int_tuple(kw.value)
+            info.unparsed |= info.static_argnums is None
+        elif kw.arg == "static_argnames":
+            info.static_argnames = _str_tuple(kw.value)
+            info.unparsed |= info.static_argnames is None
+        elif kw.arg == "donate_argnums":
+            info.donate_argnums = _int_tuple(kw.value)
+            info.unparsed |= info.donate_argnums is None
+    return info
+
+
+class _ModuleIndexer(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.scope: list[str] = []  # enclosing function names
+
+    # -- imports -----------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.asname:  # import a.b as c -> c resolves to "a.b"
+                self.mod.import_aliases[a.asname] = a.name
+            else:  # import a.b -> only the root name "a" is bound
+                root = a.name.split(".")[0]
+                self.mod.import_aliases[root] = root
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        src = node.module or ""
+        if node.level and self.mod.modname:
+            base = self.mod.modname.split(".")
+            # level=1 strips the module's own name, each extra level one
+            # more — EXCEPT in an __init__.py, whose modname already IS the
+            # containing package (`from .x import y` stays inside it)
+            strip = node.level - (1 if self.mod.is_package else 0)
+            base = base[: len(base) - strip] if strip else base
+            src = ".".join(base + ([src] if src else []))
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.mod.from_imports[a.asname or a.name] = f"{src}.{a.name}"
+
+    # -- functions ---------------------------------------------------------
+    def _handle_def(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        qual = ".".join(self.scope + [node.name])
+        args = node.args
+        all_args = list(args.posonlyargs) + list(args.args)
+        params = [a.arg for a in all_args]
+        n_def = len(args.defaults)
+        defaults: list[ast.expr | None] = [None] * (len(all_args) - n_def) + list(
+            args.defaults
+        )
+        static_like = {
+            a.arg
+            for a, d in zip(all_args, defaults)
+            if _is_static_like(a, d)
+        }
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            params.append(a.arg)
+            if _is_static_like(a, d):
+                static_like.add(a.arg)
+        fi = FunctionInfo(
+            module=self.mod,
+            qualname=qual,
+            node=node,
+            params=params,
+            static_like_params=static_like,
+        )
+        self.mod.functions[qual] = fi
+        # decorators
+        for dec in node.decorator_list:
+            wrapper_call = None
+            target = dec
+            if isinstance(dec, ast.Call):
+                dotted = self.mod.resolve_dotted(dec.func)
+                if dotted == "functools.partial" and dec.args:
+                    inner = self.mod.resolve_dotted(dec.args[0])
+                    if inner in JIT_WRAPPERS:
+                        wrapper_call, target = dec, dec.args[0]
+                        fi.jit = parse_jit_options(wrapper_call, dec)
+                        continue
+                if dotted in JIT_WRAPPERS:
+                    fi.jit = parse_jit_options(dec, dec)
+                    continue
+            else:
+                dotted = self.mod.resolve_dotted(target)
+                if dotted in JIT_WRAPPERS:
+                    fi.jit = parse_jit_options(None, dec)
+        self.scope.append(node.name)
+        self.generic_visit(node)
+        self.scope.pop()
+
+    visit_FunctionDef = _handle_def
+    visit_AsyncFunctionDef = _handle_def
+
+    # -- jit-wrapping assignments / calls ----------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = self.mod.resolve_dotted(node.func)
+        if dotted in JIT_WRAPPERS and node.args:
+            fn = self._resolve_local_function(node.args[0])
+            info = parse_jit_options(node, node)
+            if fn is not None and fn.jit is None:
+                fn.jit = info
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            dotted = self.mod.resolve_dotted(node.value.func)
+            if dotted in JIT_WRAPPERS and node.value.args:
+                fn = self._resolve_local_function(node.value.args[0])
+                info = parse_jit_options(node.value, node.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.mod.jit_assignments[t.id] = (fn, info)
+        self.generic_visit(node)
+
+    def _resolve_local_function(self, node: ast.expr) -> FunctionInfo | None:
+        if not isinstance(node, ast.Name):
+            return None
+        # innermost enclosing scope first, then module level
+        for depth in range(len(self.scope), -1, -1):
+            qual = ".".join(self.scope[:depth] + [node.id])
+            if qual in self.mod.functions:
+                return self.mod.functions[qual]
+        return None
+
+
+@dataclass
+class JitContext:
+    """One function whose body executes under jit tracing."""
+
+    fn: FunctionInfo
+    reason: str  # "jit-decorated" | "jit-wrapped" | "called from <qual>"
+    depth: int  # 0 = the jit root itself, 1 = one-level-deep helper
+
+
+class PackageIndex:
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}  # abspath -> info
+        self.by_modname: dict[str, ModuleInfo] = {}
+
+    @staticmethod
+    def build(paths: list[str]) -> "PackageIndex":
+        idx = PackageIndex()
+        for p in paths:
+            idx.add_file(p)
+        return idx
+
+    def add_file(self, path: str) -> ModuleInfo | None:
+        path = os.path.abspath(path)
+        if path in self.modules:
+            return self.modules[path]
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError):
+            return None
+        modname, display = _module_name_for(path)
+        mod = ModuleInfo(
+            path=path,
+            display_path=display,
+            modname=modname,
+            is_package=os.path.basename(path) == "__init__.py",
+            tree=tree,
+            lines=src.splitlines(),
+        )
+        _ModuleIndexer(mod).visit(tree)
+        self.modules[path] = mod
+        if modname:
+            self.by_modname[modname] = mod
+        return mod
+
+    # -- cross-module resolution ------------------------------------------
+    def resolve_call_target(
+        self, mod: ModuleInfo, call: ast.Call, scope: list[str]
+    ) -> FunctionInfo | None:
+        """Resolve a call expression to a FunctionInfo in the index: nested
+        def in an enclosing scope, module top-level def, from-import of an
+        indexed module's top-level def, or ``pkgmod.func`` attribute call."""
+        return self.resolve_function(mod, call.func, scope)
+
+    def resolve_function(
+        self, mod: ModuleInfo, func: ast.expr, scope: list[str]
+    ) -> FunctionInfo | None:
+        if isinstance(func, ast.Name):
+            for depth in range(len(scope), -1, -1):
+                qual = ".".join(scope[:depth] + [func.id])
+                if qual in mod.functions:
+                    return mod.functions[qual]
+            target = mod.from_imports.get(func.id)
+            if target:
+                srcmod, _, name = target.rpartition(".")
+                other = self.by_modname.get(srcmod)
+                if other and name in other.functions:
+                    return other.functions[name]
+        elif isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            base = mod.import_aliases.get(func.value.id) or mod.from_imports.get(
+                func.value.id
+            )
+            if base:
+                other = self.by_modname.get(base)
+                if other and func.attr in other.functions:
+                    return other.functions[func.attr]
+        return None
+
+    # -- jit reachability --------------------------------------------------
+    def jit_contexts(self) -> list[JitContext]:
+        """Every jit-rooted function plus package-local helpers called
+        directly from a jit-rooted body (one level deep)."""
+        out: list[JitContext] = []
+        seen: set[tuple[str, str]] = set()
+        roots: list[FunctionInfo] = []
+        for mod in self.modules.values():
+            for fi in mod.functions.values():
+                if fi.jit is not None:
+                    roots.append(fi)
+        for fi in roots:
+            key = (fi.module.path, fi.qualname)
+            if key not in seen:
+                seen.add(key)
+                out.append(JitContext(fn=fi, reason="jit-rooted", depth=0))
+        for fi in roots:
+            scope = fi.qualname.split(".")
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callees = []
+                direct = self.resolve_call_target(fi.module, node, scope)
+                if direct is not None:
+                    callees.append((direct, "called from"))
+                # `jax.value_and_grad(loss_fn)` and friends run loss_fn
+                # under this trace too
+                dotted = fi.module.resolve_dotted(node.func)
+                if dotted in JIT_TRANSFORMS:
+                    for arg in node.args:
+                        handed = self.resolve_function(fi.module, arg, scope)
+                        if handed is not None:
+                            callees.append((handed, f"handed to {dotted} from"))
+                for callee, how in callees:
+                    if callee.jit is not None:
+                        continue
+                    key = (callee.module.path, callee.qualname)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(
+                        JitContext(
+                            fn=callee,
+                            reason=f"{how} jit-rooted {fi.qualname} "
+                            f"({fi.module.display_path}:{node.lineno})",
+                            depth=1,
+                        )
+                    )
+        return out

@@ -26,14 +26,18 @@ non-local samples from their owners.
 Wire format is a length-prefixed binary array framing (name + dtype str +
 shape + raw bytes per array): decode is ``np.frombuffer`` views — no
 pickle anywhere, and object dtypes are rejected on both ends, so a
-malicious peer cannot execute code on load. The trust model is otherwise
-the reference's — an internal cluster network, like its MPI windows —
-hardened further by an optional ``auth_token`` handshake and a bindable
-listen interface.
+malicious peer cannot execute code on load. The trust model is the
+reference's — an internal cluster network, like its MPI windows. The
+optional ``auth_token`` and bindable listen interface protect against
+MISCONFIGURATION (two jobs sharing a fabric, a peer dialing the wrong
+port), not against a network attacker: the token travels plaintext over
+unencrypted TCP and is replayable. Genuinely untrusted networks need
+transport security (TLS/WireGuard) underneath, same as MPI would.
 """
 
 from __future__ import annotations
 
+import hmac
 import socket
 import socketserver
 import struct
@@ -179,6 +183,25 @@ def _sample_from_arrays(d: dict[str, np.ndarray]) -> GraphSample:
     return s
 
 
+def _copy_sample(s: GraphSample) -> GraphSample:
+    """Independent deep-ish copy: fresh array buffers, fresh extras dict.
+    The LRU cache hands these out because downstream transforms mutate
+    samples in place — a cache that returns its own instances corrupts
+    every later hit of the same index (ADVICE.md r5)."""
+    out = GraphSample.__new__(GraphSample)
+    for f in GraphSample.__slots__:
+        v = getattr(s, f)
+        if isinstance(v, np.ndarray):
+            v = v.copy()
+        elif f == "extras":
+            v = {
+                k: (x.copy() if isinstance(x, np.ndarray) else x)
+                for k, x in v.items()
+            }
+        setattr(out, f, v)
+    return out
+
+
 def _encode_samples(samples: list[GraphSample]) -> bytes:
     flat = {}
     for i, s in enumerate(samples):
@@ -210,18 +233,21 @@ class ShardServer:
 
     ``host`` restricts the listening interface (default all interfaces —
     the reference's MPI-window trust model on an isolated cluster fabric);
-    ``auth_token`` adds a per-request shared-secret check for multi-tenant
-    networks (n=-2 error record on mismatch). ``_test_delay_s`` is a test
-    hook: a per-request sleep that makes fetch-overlap measurements
-    deterministic instead of timing-noise-bound."""
+    ``auth_token`` adds a per-request shared-secret check (n=-2 error
+    record on mismatch). The token is a MISCONFIGURATION guard — it stops
+    a peer from another job/cluster accidentally reading this shard — not
+    network security: it travels plaintext and is replayable, so an
+    attacker who can sniff the fabric already has the data. The compare is
+    ``hmac.compare_digest`` so the guard itself doesn't leak the token
+    byte-by-byte through timing. ``_test_delay_s`` is a test hook: a
+    per-request sleep that makes fetch-overlap measurements deterministic
+    instead of timing-noise-bound."""
 
     def __init__(self, ds: PackedDataset, start: int, stop: int,
                  host: str = "0.0.0.0", auth_token: str | None = None,
                  _test_delay_s: float = 0.0):
         outer = self
-        tok = None if auth_token is None else np.frombuffer(
-            auth_token.encode(), np.uint8
-        )
+        tok = None if auth_token is None else auth_token.encode()
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
@@ -243,8 +269,10 @@ class ShardServer:
                             time.sleep(outer._test_delay_s)
                         got_tok = z.get("token")
                         if tok is not None and (
-                            got_tok is None or got_tok.shape != tok.shape
-                            or not bool(np.all(got_tok == tok))
+                            got_tok is None
+                            or not hmac.compare_digest(
+                                np.asarray(got_tok).tobytes(), tok
+                            )
                         ):
                             _send_msg(self.request, _pack_arrays(
                                 {"n": np.asarray(-2, np.int64)}
@@ -530,7 +558,14 @@ class ShardedStore:
         remote ones with ONE request per owning host. Only the cache
         bookkeeping is serialized; the network round-trips run on pooled
         per-call sockets, so concurrent callers (PrefetchLoader workers)
-        overlap their remote fetches."""
+        overlap their remote fetches.
+
+        Mutability contract: LOCAL samples are zero-copy READ-ONLY mmap
+        views (an in-place write raises — loud, safe, and free); REMOTE
+        samples are independent writable copies (the LRU cache keeps its
+        own pristine instance, so a caller mutating one can never corrupt
+        a later cache hit). Transforms that write in place must copy
+        first; transforms that build new arrays work on both."""
         out: dict[int, GraphSample] = {}
         by_owner: dict[int, list[int]] = {}
         remote: list[int] = []
@@ -541,15 +576,22 @@ class ShardedStore:
                 remote.append(i)
         if remote:
             pending: set[int] = set()
+            hits: dict[int, GraphSample] = {}
             with self._lock:
                 for i in remote:
                     if i in self._cache:
                         self._cache.move_to_end(i)
-                        out[i] = self._cache[i]
+                        hits[i] = self._cache[i]  # reference only under lock
                     elif i not in pending:
                         pending.add(i)
                         rank = self._owner(i)[0]
                         by_owner.setdefault(rank, []).append(i)
+            # copy on hit OUTSIDE the lock (the lock serializes bookkeeping
+            # only — array memcpy under it would stall concurrent workers):
+            # callers mutate samples in place (transforms); the cache's
+            # instance stays pristine
+            for i, s in hits.items():
+                out[i] = _copy_sample(s)
         def fetch_owner(item):
             rank, idxs = item
             host, port, s0, s1 = self.peers[rank]
@@ -579,14 +621,30 @@ class ShardedStore:
                         self._executor = ThreadPoolExecutor(16)
             results = list(self._executor.map(fetch_owner, by_owner.items()))
         for idxs, samples in results:
+            # the caller gets the freshly decoded instance; the cache keeps
+            # its OWN copy (made before taking the lock) so later hits are
+            # unaffected by whatever the caller does to this one
+            cache_copies = [_copy_sample(s) for s in samples]
             with self._lock:
                 self.remote_fetches += len(samples)
-                for i, s in zip(idxs, samples):
+                for i, s, c in zip(idxs, samples, cache_copies):
                     out[i] = s
-                    self._cache[i] = s
+                    self._cache[i] = c
                 while len(self._cache) > self._cache_size:
                     self._cache.popitem(last=False)
-        return [out[int(i)] for i in indices]
+        # duplicate REMOTE indices must not share one writable instance
+        # across result positions (the isolation contract above); local
+        # read-only mmap views are safe to share
+        result: list[GraphSample] = []
+        emitted: set[int] = set()
+        for i in map(int, indices):
+            s = out[i]
+            if i in emitted and not (self.start <= i < self.stop):
+                s = _copy_sample(s)
+            else:
+                emitted.add(i)
+            result.append(s)
+        return result
 
     def pad_spec(self, batch_size: int, node_multiple: int = 8, edge_multiple: int = 128):
         """PadSpec from shard-local writer stats, maxed across hosts when

@@ -344,6 +344,39 @@ def train_validate_test(
     if len(val_loader.samples) == 0 or len(test_loader.samples) == 0:
         skip_valtest = True
 
+    # HYDRAGNN_COMPILE_SENTINEL: after the warm-up epoch every (shape,
+    # treedef) bucket must be compiled — a later epoch compiling ANYTHING
+    # new means bucket/pytree instability silently eating accelerator time.
+    # 'warn' reports the delta, 'strict' fails the run.
+    sentinel_mode = str(flags.get(flags.COMPILE_SENTINEL) or "").strip().lower()
+    if sentinel_mode in ("", "0", "false", "off"):
+        sentinel_mode = None
+    elif sentinel_mode not in ("warn", "strict"):
+        # a typo must not silently downgrade a CI gate to warn-and-stay-green
+        raise ValueError(
+            f"HYDRAGNN_COMPILE_SENTINEL={sentinel_mode!r}: expected 'warn', "
+            "'strict', or unset/0"
+        )
+    lowerings_at_epoch_start = 0
+    if sentinel_mode is not None:
+        from ..analysis.sentinel import RecompileError, compile_counts
+
+    def _sentinel_epoch_end(epoch: int) -> None:
+        if sentinel_mode is None:
+            return
+        delta = compile_counts()["lowerings"] - lowerings_at_epoch_start
+        if epoch == 0 or delta == 0:
+            return
+        msg = (
+            f"compile sentinel: epoch {epoch} compiled {delta} new XLA "
+            "program(s) after the warm-up epoch — a shape/bucket/pytree "
+            "instability is retracing the hot loop "
+            f"(HYDRAGNN_COMPILE_SENTINEL={sentinel_mode})"
+        )
+        if sentinel_mode == "strict":
+            raise RecompileError(msg)
+        print_distributed(verbosity, msg)
+
     # HYDRAGNN_TRACE_LEVEL>=1: profile the first epoch (reference wraps the
     # loop in torch.profiler at TRACE_LEVEL, train_validate_test.py:324,675)
     def _profiler(action: str) -> bool:
@@ -362,6 +395,8 @@ def train_validate_test(
 
     for epoch in range(num_epoch):
         os.environ["HYDRAGNN_EPOCH"] = str(epoch)  # exported for tools (reference :316)
+        if sentinel_mode is not None:
+            lowerings_at_epoch_start = compile_counts()["lowerings"]
         train_loader.set_epoch(epoch)
         state, train_loss, train_tasks = train_epoch(
             train_step, state, train_loader, verbosity, mesh=mesh, put_fn=put_fn,
@@ -381,6 +416,10 @@ def train_validate_test(
             # without evaluation — a SLURM kill must not lose the run
             if checkpoint is not None:
                 checkpoint(state, epoch, train_loss)
+            # sentinel AFTER checkpointing: a strict-mode abort is a perf
+            # gate tripping, not state corruption — the epoch's work is
+            # valid and must survive the raise
+            _sentinel_epoch_end(epoch)
             if walltime_check is not None and walltime_check():
                 print_distributed(verbosity, f"Walltime guard tripped at epoch {epoch}")
                 break
@@ -413,6 +452,9 @@ def train_validate_test(
 
         if checkpoint is not None:
             checkpoint(state, epoch, val_loss)
+        # sentinel AFTER checkpointing (see the skip_valtest path): a
+        # strict-mode abort must not lose the epoch's valid state
+        _sentinel_epoch_end(epoch)
         if early_stopping is not None and early_stopping(val_loss):
             print_distributed(verbosity, f"Early stopping at epoch {epoch}")
             break

@@ -57,9 +57,18 @@ def select_optimizer(optimizer_config: dict) -> optax.GradientTransformation:
 
 
 def set_learning_rate(opt_state, lr: float):
-    """Overwrite the injected LR in an optimizer state (returns new state)."""
+    """Overwrite the injected LR in an optimizer state (returns new state).
+
+    The new value mirrors the old leaf's dtype/weak-type exactly: a plain
+    Python float here would change the jit cache key of the train step
+    (strong f32 array -> weak float) and force one retrace per LR decay —
+    breaking the no-recompile promise in the module docstring (and tripping
+    HYDRAGNN_COMPILE_SENTINEL on perfectly healthy runs)."""
+    import jax.numpy as jnp
+
     hp = dict(opt_state.hyperparams)
-    hp["learning_rate"] = lr
+    old = hp["learning_rate"]
+    hp["learning_rate"] = jnp.asarray(lr, dtype=getattr(old, "dtype", jnp.float32))
     return opt_state._replace(hyperparams=hp)
 
 

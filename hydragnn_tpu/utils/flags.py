@@ -103,6 +103,12 @@ NATIVE = _register(Flag(
 COMPILE_CACHE = _register(Flag(
     "HYDRAGNN_COMPILE_CACHE", "path", "./.jax_cache",
     "Persistent XLA compilation cache dir (=0 disables)."))
+COMPILE_SENTINEL = _register(Flag(
+    "HYDRAGNN_COMPILE_SENTINEL", "str", None,
+    "Guard steady-state epochs against silent jit recompilation "
+    "(analysis/sentinel.py): 'warn' prints the per-epoch compile delta "
+    "after the warm-up epoch, 'strict' raises RecompileError; unset/0 "
+    "disables."))
 
 # -- config / observability -------------------------------------------------
 USE_VARIABLE_GRAPH_SIZE = _register(Flag(

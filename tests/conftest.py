@@ -52,3 +52,9 @@ def random_molecule_samples(n, seed=0, lo=9, hi=30):
             )
         )
     return out
+
+
+# Recompile-sentinel fixture (hydragnn_tpu.analysis.sentinel): any test can
+# `def test_x(compile_sentinel): ... with compile_sentinel(max_compiles=0): ...`
+# to assert jit compile-count stability over a region.
+from hydragnn_tpu.analysis.sentinel import compile_sentinel  # noqa: E402,F401

@@ -1,0 +1,18 @@
+"""GL001 fixture: host-device syncs reachable from jit-traced code.
+Violation lines carry an expectation tag; each must produce one finding."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(state, batch):
+    loss = (state * batch).sum()
+    host = loss.item()  # EXPECT:GL001
+    arr = np.asarray(batch)  # EXPECT:GL001
+    scale = float(loss)  # EXPECT:GL001
+    loss.block_until_ready()  # EXPECT:GL001
+    return helper(state) + host + arr.sum() + scale
+
+
+def helper(s):
+    return s.tolist()  # EXPECT:GL001

@@ -1,0 +1,22 @@
+"""GL001 clean twin: same shapes of code, no syncs inside traced regions."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(state, batch):
+    loss = (state * batch).sum()
+    arr = jnp.asarray(batch)  # jnp stays on device
+    n = batch.shape[0]  # static attribute reads are fine
+    return helper(state) + loss + arr.sum() + n
+
+
+def helper(s):
+    return jnp.sum(s)
+
+
+def report(state, batch):
+    # OUTSIDE jit: syncing is the whole point here
+    metrics = step(state, batch)
+    return float(np.asarray(metrics).item())

@@ -1,0 +1,19 @@
+"""GL002 fixture: Python control flow on traced values."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(state, batch):
+    if batch.sum() > 0:  # EXPECT:GL002
+        state = state + 1
+    while state < 10:  # EXPECT:GL002
+        state = state * 2
+    scaled = state * 2 if batch else state  # EXPECT:GL002
+    return clamp(scaled)
+
+
+def clamp(x):
+    if x > 1:  # EXPECT:GL002
+        return jnp.ones(())
+    return x

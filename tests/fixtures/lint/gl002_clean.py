@@ -1,0 +1,23 @@
+"""GL002 clean twin: static branching and device-side selection."""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def step(state, batch, train: bool = False):
+    if train:  # bool-annotated param: trace-time static by convention
+        state = state + 1
+    if batch.shape[0] > 8:  # shape reads are static
+        state = state * 2
+    if batch is None:  # identity test, never traced
+        return state
+    state = jnp.where(batch.sum() > 0, state + 1, state)  # device-side select
+    state = lax.while_loop(lambda s: s < 10, lambda s: s * 2, state)
+    return clamp(state)
+
+
+def clamp(x):
+    if isinstance(x, tuple):  # introspection is static
+        x = x[0]
+    return jnp.minimum(x, 1.0)

@@ -1,0 +1,17 @@
+"""GL003 fixture: jit wrappers constructed per loop iteration."""
+import functools
+
+import jax
+
+
+def train(batches, fn):
+    total = 0
+    for b in batches:
+        step = jax.jit(fn)  # EXPECT:GL003
+        total += step(b)
+    i = 0
+    while i < 3:
+        g = functools.partial(jax.jit, static_argnums=(1,))(fn)  # EXPECT:GL003
+        total += g(i, 2)
+        i += 1
+    return total

@@ -1,0 +1,10 @@
+"""GL003 clean twin: the jit is built once, outside the loop."""
+import jax
+
+
+def train(batches, fn):
+    step = jax.jit(fn)  # hoisted: one cache for every iteration
+    total = 0
+    for b in batches:
+        total += step(b)
+    return total

@@ -1,0 +1,23 @@
+"""GL004 fixture: static/donate argument-spec mismatches."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(0,))  # EXPECT:GL004
+def overlap(a, b):
+    return a + b
+
+
+def scale(x, factor):
+    return x * factor
+
+
+out_of_range = jax.jit(scale, static_argnums=(5,))  # EXPECT:GL004
+
+bad_name = jax.jit(scale, static_argnames=("gamma",))  # EXPECT:GL004
+
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def with_default(x, opts={"mode": "fast"}):  # EXPECT:GL004
+    return x

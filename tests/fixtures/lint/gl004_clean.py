@@ -1,0 +1,21 @@
+"""GL004 clean twin: consistent, hashable static/donate specs."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
+def update(state, batch, lr: float = 1e-3):
+    return state - lr * batch
+
+
+def scale(x, factor):
+    return x * factor
+
+
+jitted = jax.jit(scale, static_argnames=("factor",))
+
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def with_default(x, opts=("fast",)):  # tuple default: hashable cache key
+    return x

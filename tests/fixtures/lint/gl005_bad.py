@@ -1,0 +1,19 @@
+"""GL005 fixture: dict pytrees from iteration-order-sensitive sources."""
+import glob
+import os
+
+
+def head_params(names):
+    return {k: 0.0 for k in set(names)}  # EXPECT:GL005
+
+def from_listing(d):
+    return {f: load(f) for f in os.listdir(d)}  # EXPECT:GL005
+
+def from_glob(pattern, vals):
+    return dict(zip(glob.glob(pattern), vals))  # EXPECT:GL005
+
+def from_union(a, b):
+    return {k: 1 for k in set(a) | set(b)}  # EXPECT:GL005
+
+def load(f):
+    return f

@@ -1,0 +1,19 @@
+"""GL005 clean twin: sources sorted before keying a dict pytree."""
+import glob
+import os
+
+
+def head_params(names):
+    return {k: 0.0 for k in sorted(set(names))}
+
+def from_listing(d):
+    return {f: load(f) for f in sorted(os.listdir(d))}
+
+def from_glob(pattern, vals):
+    return dict(zip(sorted(glob.glob(pattern)), vals))
+
+def over_list(names):
+    return {k: 0.0 for k in names}  # lists keep their order: fine
+
+def load(f):
+    return f

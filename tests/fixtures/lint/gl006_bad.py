@@ -1,0 +1,33 @@
+"""GL006 fixture: donated buffers read after the donating call."""
+import jax
+
+
+def update(state, batch):
+    return state + batch
+
+
+step = jax.jit(update, donate_argnums=(0,))
+
+
+def train_epoch(state, batches):
+    new_state = step(state, batches[0])
+    checkpoint(state)  # EXPECT:GL006
+    norm = state.sum()  # EXPECT:GL006
+    return new_state, norm
+
+
+def guarded_epoch(state, batch):
+    out = step(state, batch)
+    try:
+        validate(out)
+    except ValueError:
+        checkpoint(state)  # EXPECT:GL006
+    return out
+
+
+def validate(s):
+    return s
+
+
+def checkpoint(s):
+    return s

@@ -1,0 +1,33 @@
+"""GL007 fixture: mutable defaults and cache-aliased returns."""
+
+
+def collect(x, acc=[]):  # EXPECT:GL007
+    acc.append(x)
+    return acc
+
+
+def options(name, opts={}):  # EXPECT:GL007
+    return opts.get(name)
+
+
+class Store:
+    def __init__(self):
+        self._cache = {}
+
+    def get(self, i):
+        if i in self._cache:
+            return self._cache[i]  # EXPECT:GL007
+        s = self._load(i)
+        self._cache[i] = s
+        return s  # EXPECT:GL007
+
+    def fetch(self, indices):
+        out = {}
+        for i in indices:
+            s = self._load(i)
+            out[i] = s
+            self._cache[i] = s
+        return [out[i] for i in indices]  # EXPECT:GL007
+
+    def _load(self, i):
+        return [i]

@@ -1,0 +1,24 @@
+"""GL007 clean twin: None defaults and copy-on-return caching."""
+import copy
+
+
+def collect(x, acc=None):
+    if acc is None:
+        acc = []
+    acc.append(x)
+    return acc
+
+
+class Store:
+    def __init__(self):
+        self._cache = {}
+
+    def get(self, i):
+        if i in self._cache:
+            return copy.deepcopy(self._cache[i])
+        s = self._load(i)
+        self._cache[i] = copy.deepcopy(s)
+        return s
+
+    def _load(self, i):
+        return [i]

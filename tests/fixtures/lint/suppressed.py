@@ -1,0 +1,17 @@
+"""Suppression-syntax fixture: every violation here is deliberately
+silenced; the analyzer must report NOTHING for this file."""
+import jax
+
+
+@jax.jit
+def step(state):
+    host = state.item()  # graftlint: disable=GL001
+    # graftlint: disable-next=GL002
+    if state > 0:
+        host += 1
+    return state + host
+
+
+def collect(x, acc=[]):  # graftlint: disable=all
+    acc.append(x)
+    return acc

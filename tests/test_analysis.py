@@ -1,0 +1,411 @@
+"""graftlint (hydragnn_tpu.analysis) + recompile-sentinel gates.
+
+Rule tests are corpus-driven: every ``tests/fixtures/lint/glXXX_bad.py``
+tags its violations with ``# EXPECT:GLXXX`` and the test asserts the
+analyzer reports EXACTLY those (rule, line) pairs — and nothing at all on
+the ``_clean`` twin under the FULL rule set, so each clean idiom doubles as
+a false-positive regression for every rule.
+
+``test_package_is_clean`` is the tier-1 enforcement: the real CI invocation
+(``python -m hydragnn_tpu.analysis hydragnn_tpu/ --fail-on-new``) must stay
+exit-0 forever; new violations must be fixed or individually justified in
+``hydragnn_tpu/analysis/baseline.json``.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from hydragnn_tpu.analysis import analyze
+from hydragnn_tpu.analysis.core import BaselineError, load_baseline, split_new
+from hydragnn_tpu.analysis.sentinel import (
+    RecompileError,
+    assert_compile_count,
+    compile_counts,
+    no_recompile,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "lint"
+RULE_IDS = ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"]
+
+_EXPECT = re.compile(r"EXPECT:(GL\d{3})")
+
+
+def expected_findings(path: Path) -> set[tuple[str, int]]:
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        for m in _EXPECT.finditer(line):
+            out.add((m.group(1), i))
+    return out
+
+
+# -- rule corpus -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_rule_reports_exact_locations(rule):
+    bad = FIXTURES / f"{rule.lower()}_bad.py"
+    expected = expected_findings(bad)
+    assert expected, f"fixture {bad.name} has no EXPECT tags"
+    findings = analyze([str(bad)], rule_ids=[rule])
+    got = {(f.rule, f.line) for f in findings}
+    assert got == expected, (
+        f"{bad.name}: expected {sorted(expected)}, got "
+        f"{[f.format() for f in findings]}"
+    )
+
+
+@pytest.mark.parametrize("rule", RULE_IDS)
+def test_clean_twin_has_zero_findings_under_all_rules(rule):
+    clean = FIXTURES / f"{rule.lower()}_clean.py"
+    findings = analyze([str(clean)])  # full rule set: cross-rule FP guard
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_suppression_comments_silence_findings():
+    path = FIXTURES / "suppressed.py"
+    assert analyze([str(path)]) == []
+    raw = analyze([str(path)], respect_suppressions=False)
+    assert {f.rule for f in raw} >= {"GL001", "GL002", "GL007"}
+
+
+def test_unparsable_file_is_a_finding_not_a_skip(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    findings = analyze([str(bad)])
+    assert [f.rule for f in findings] == ["GL000"]
+
+
+def test_two_unparsable_files_same_basename_both_reported(tmp_path):
+    for d in ("a", "b"):
+        (tmp_path / d).mkdir()
+        (tmp_path / d / "broken.py").write_text("def f(:\n")
+    findings = analyze([str(tmp_path / "a"), str(tmp_path / "b")])
+    assert [f.rule for f in findings] == ["GL000", "GL000"]
+    assert len({f.path for f in findings}) == 2
+
+
+def test_jit_reachability_through_package_init_relative_import(tmp_path):
+    """`from .helpers import helper` in a package __init__.py must resolve
+    INSIDE the package — a one-level-too-high resolution silently loses the
+    jit-reachability edge and the GL001 false negative with it."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(
+        "import jax\n"
+        "from .helpers import helper\n\n\n"
+        "@jax.jit\n"
+        "def root(x):\n"
+        "    return helper(x)\n"
+    )
+    (pkg / "helpers.py").write_text(
+        "def helper(x):\n"
+        "    return x.item()\n"
+    )
+    findings = analyze([str(pkg)], rule_ids=["GL001"])
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("GL001", "pkg/helpers.py", 2)
+    ]
+
+
+def test_unknown_rule_id_rejected():
+    with pytest.raises(ValueError, match="GL999"):
+        analyze([str(FIXTURES / "gl001_bad.py")], rule_ids=["GL999"])
+
+
+def test_scanning_nothing_is_an_error_not_a_green_exit(tmp_path):
+    """A typo'd path must not silently disable the gate."""
+    with pytest.raises(ValueError, match="refusing to scan nothing"):
+        analyze([str(tmp_path / "no_such_package")])
+    (tmp_path / "empty_dir").mkdir()
+    with pytest.raises(ValueError, match="no .py files"):
+        analyze([str(tmp_path / "empty_dir")])
+    proc = _run_cli("hydragn_typo", "--fail-on-new")
+    assert proc.returncode == 2
+
+
+def test_explicit_missing_baseline_is_an_error():
+    """A typo'd --baseline must not silently run with an empty baseline
+    (only the never-written DEFAULT baseline gets that treatment)."""
+    proc = _run_cli(
+        "hydragnn_tpu", "--fail-on-new", "--baseline", "basline_typo.json"
+    )
+    assert proc.returncode == 2
+    assert "does not exist" in proc.stderr
+
+
+# -- baseline machinery ------------------------------------------------------
+
+
+def test_gl003_nested_loop_reports_once(tmp_path):
+    p = tmp_path / "nested.py"
+    p.write_text(
+        "import jax\n\n\n"
+        "def f(batches, fn):\n"
+        "    for group in batches:\n"
+        "        for b in group:\n"
+        "            step = jax.jit(fn)\n"
+        "            step(b)\n"
+    )
+    findings = analyze([str(p)], rule_ids=["GL003"])
+    assert [(f.rule, f.line) for f in findings] == [("GL003", 7)]
+
+
+def test_baseline_matches_on_snippet_not_line(tmp_path):
+    findings = analyze([str(FIXTURES / "gl003_bad.py")], rule_ids=["GL003"])
+    assert findings
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "snippet": "  " + f.snippet + "  ",  # whitespace-insensitive
+            "reason": "fixture: grandfathered on purpose",
+        }
+        for f in findings
+    ]
+    new, baselined = split_new(findings, entries)
+    assert new == [] and len(baselined) == len(findings)
+
+
+def test_baseline_entry_covers_exactly_count_occurrences():
+    """One baselined `x.item()` must NOT grandfather a second identical-text
+    violation added later in the same file."""
+    from hydragnn_tpu.analysis.core import Finding
+
+    f = Finding(rule="GL001", path="m.py", line=10, col=1,
+                message="m", snippet="x = v.item()")
+    twin = Finding(rule="GL001", path="m.py", line=90, col=1,
+                   message="m", snippet="x = v.item()")
+    entry = {"rule": "GL001", "path": "m.py", "snippet": "x = v.item()",
+             "reason": "grandfathered once"}
+    new, old = split_new([f, twin], [entry])
+    assert len(old) == 1 and len(new) == 1
+    new, old = split_new([f, twin], [dict(entry, count=2)])
+    assert new == [] and len(old) == 2
+
+
+def test_baseline_without_reason_is_refused(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({
+        "version": 1,
+        "findings": [{"rule": "GL001", "path": "x.py", "snippet": "y", "reason": " "}],
+    }))
+    with pytest.raises(BaselineError, match="reason"):
+        load_baseline(str(p))
+
+
+def test_unreviewed_placeholder_reason_is_refused(tmp_path):
+    """--write-baseline stamps 'UNREVIEWED: ...'; committing it unedited
+    must fail the gate, not satisfy the reason requirement."""
+    from hydragnn_tpu.analysis.core import write_baseline
+
+    findings = analyze([str(FIXTURES / "gl003_bad.py")], rule_ids=["GL003"])
+    p = tmp_path / "baseline.json"
+    write_baseline(str(p), findings, reason="UNREVIEWED: drafted, not vetted")
+    with pytest.raises(BaselineError, match="UNREVIEWED"):
+        load_baseline(str(p))
+
+
+def test_committed_baseline_entries_all_carry_reasons():
+    # load_baseline raises on reasonless entries; loading the committed
+    # file IS the audit (acceptance: every grandfathered finding justified)
+    entries = load_baseline(str(REPO / "hydragnn_tpu" / "analysis" / "baseline.json"))
+    for e in entries:
+        assert len(str(e["reason"]).strip()) > 10
+
+
+# -- CLI / tier-1 enforcement ------------------------------------------------
+
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "hydragnn_tpu.analysis", *args],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+def test_package_is_clean():
+    """Tier-1 gate: the CI invocation exits 0 on the committed tree."""
+    proc = _run_cli("hydragnn_tpu", "--fail-on-new")
+    assert proc.returncode == 0, f"\n{proc.stdout}\n{proc.stderr}"
+
+
+def test_injected_violation_fails_the_cli():
+    proc = _run_cli(
+        "hydragnn_tpu", str(FIXTURES / "gl001_bad.py"), "--fail-on-new"
+    )
+    assert proc.returncode == 1
+    assert "GL001" in proc.stdout
+
+
+def test_ruff_clean_when_available():
+    """[tool.ruff] in pyproject.toml is authoritative wherever ruff exists;
+    this container doesn't ship it, so the gate activates opportunistically."""
+    import shutil
+
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        pytest.skip("ruff not installed in this image")
+    proc = subprocess.run(
+        [ruff, "check", "hydragnn_tpu", "tests"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- recompile sentinel ------------------------------------------------------
+
+
+def test_no_recompile_passes_when_warm():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    x = jnp.ones((8,))
+    y = x + 1  # inputs (and their op compiles) happen OUTSIDE the region
+    f(x)  # warm
+    with no_recompile(what="steady toy step"):
+        f(x)
+        f(y)  # same shape/dtype: cache hit
+
+
+def test_no_recompile_catches_retrace_and_names_region():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x.sum()
+
+    xs = [jnp.ones((n,)) for n in (3, 4, 5)]  # built OUTSIDE the region
+    f(xs[0])
+    with pytest.raises(RecompileError) as ei:
+        with no_recompile(max_compiles=0, what="shape-unstable toy loop"):
+            for x in xs:
+                f(x)
+    msg = str(ei.value)
+    assert "shape-unstable toy loop" in msg
+    assert "declared at most 0" in msg
+    assert "pre-warm" in msg
+
+
+def test_no_recompile_allows_declared_budget():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def g(x):
+        return x - 1
+
+    xs = [jnp.ones((n,)) for n in (2, 3)]
+    with no_recompile(max_compiles=2, what="two declared compiles"):
+        for x in xs:
+            g(x)
+
+
+def test_assert_compile_count_exact():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def h(x):
+        return x * x
+
+    a = jnp.ones((4,))
+    assert_compile_count(h, [(a,), (a,)], expected=1, what="h twice same shape")
+    with pytest.raises(RecompileError, match="expected exactly 0"):
+        assert_compile_count(h, [(jnp.ones((6,)),)], expected=0, what="h new shape")
+
+
+def test_compile_sentinel_fixture(compile_sentinel):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x + 3
+
+    x = jnp.ones((5,))
+    f(x)
+    with compile_sentinel(max_compiles=0, what="fixture steady state"):
+        f(x)
+    assert compile_counts()["lowerings"] >= 1  # counters are live
+
+
+def test_train_loop_honors_compile_sentinel_flag(monkeypatch, tmp_path):
+    """HYDRAGNN_COMPILE_SENTINEL=strict through the REAL epoch loop: with a
+    deterministic loader (stable padded buckets) epochs after warm-up must
+    compile nothing new, so a 3-epoch run completes instead of raising."""
+    import copy as _copy
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train import select_optimizer
+    from hydragnn_tpu.train.loop import train_validate_test
+    from hydragnn_tpu.train.step import create_train_state
+    from test_config import CI_CONFIG
+
+    monkeypatch.setenv("HYDRAGNN_COMPILE_SENTINEL", "strict")
+    monkeypatch.chdir(tmp_path)  # the loop writes ./logs/<run>/
+    import jax
+    import jax.numpy as jnp
+
+    cfg = _copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = 3
+    samples = deterministic_graph_data(number_configurations=16, seed=1)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    opt = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    loaders = [GraphLoader(samples, 8, shuffle=False) for _ in range(3)]
+    batch = jax.tree.map(jnp.asarray, next(iter(loaders[0])))
+    state = create_train_state(model, opt, batch)
+    state = train_validate_test(
+        model, opt, state, *loaders, cfg["NeuralNetwork"], "sentinel_run",
+    )
+    assert int(state.step) == 3 * len(loaders[0])
+
+
+def test_sentinel_catches_shape_unstable_train_step():
+    """Acceptance gate: a REAL train step (model + optimizer + jit) fed a
+    batch padded to a different static shape must trip the sentinel."""
+    import copy as _copy
+
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train import select_optimizer
+    from hydragnn_tpu.train.step import create_train_state, make_train_step
+    from test_config import CI_CONFIG
+
+    cfg = _copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=16, seed=0)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    opt = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    batch8 = jax.tree.map(jnp.asarray, next(iter(GraphLoader(samples, 8))))
+    batch4 = jax.tree.map(jnp.asarray, next(iter(GraphLoader(samples, 4))))
+
+    state = create_train_state(model, opt, batch8)
+    step = make_train_step(model, opt)
+    state, _ = step(state, batch8)  # warm the batch8 bucket
+    with no_recompile(what="warmed train step, same bucket"):
+        state, _ = step(state, batch8)
+    with pytest.raises(RecompileError, match="train step"):
+        with no_recompile(what="shape-unstable train step"):
+            step(state, batch4)  # different padded bucket -> retrace

@@ -383,6 +383,49 @@ def test_sharded_store_auth_token_and_bind_host(tmp_path):
         srv.close()
 
 
+def test_sharded_store_cache_hits_are_isolated(tmp_path):
+    """ADVICE.md r5: fetch() used to hand out the LRU cache's own
+    GraphSample instances while downstream transforms mutate samples in
+    place — mutating one fetch's result corrupted every later cache hit of
+    that index. Every fetch must now return an independent copy."""
+    import numpy as np
+
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+
+    samples = deterministic_graph_data(number_configurations=12, seed=5)
+    p0, p1 = str(tmp_path / "a.gpk"), str(tmp_path / "b.gpk")
+    PackedWriter(samples[:6], p0)
+    PackedWriter(samples[6:], p1)
+    srv = ShardedStore(p1, 6, 12,
+                       peers=[("127.0.0.1", 0, 0, 6), ("127.0.0.1", 0, 6, 12)])
+    store = ShardedStore(p0, 0, 6,
+                         peers=[("127.0.0.1", 0, 0, 6),
+                                ("127.0.0.1", srv.server.port, 6, 12)])
+    try:
+        pristine = np.array(samples[8].x)
+        first = store.fetch([8])[0]  # remote: populates the cache
+        first.x[:] = -777.0  # in-place transform on the returned sample
+        first.extras["poison"] = True
+        hit = store.fetch([8])[0]  # cache hit: must be unaffected
+        assert store.remote_fetches == 1  # second fetch really hit the cache
+        np.testing.assert_array_equal(hit.x, pristine)
+        assert "poison" not in hit.extras
+        # and the hit itself is ALSO isolated: mutate it, fetch again
+        hit.x[:] = -888.0
+        again = store.fetch([8])[0]
+        assert store.remote_fetches == 1
+        np.testing.assert_array_equal(again.x, pristine)
+        # duplicate remote indices in ONE fetch: every position independent
+        a, b = store.fetch([8, 8])
+        a.x[:] = -999.0
+        np.testing.assert_array_equal(b.x, pristine)
+    finally:
+        store.close()
+        srv.close()
+
+
 def test_sharded_store_concurrent_fetch_overlap(tmp_path):
     """The connection pool must let concurrent fetches overlap their network
     waits (round-4 verdict item 2): with a 120ms per-request server delay,
