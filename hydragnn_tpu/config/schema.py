@@ -229,6 +229,9 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     arch.setdefault("activation_function", "relu")
     arch.setdefault("SyncBatchNorm", False)
     training.setdefault("conv_checkpointing", False)
+    # K train steps per device dispatch (train/superstep.py); env override
+    # HYDRAGNN_SUPERSTEP wins at loop time
+    training.setdefault("steps_per_dispatch", 1)
     training.setdefault("loss_function_type", "mse")
     training.setdefault("precision", "fp32")
     training.setdefault("batch_size", 32)

@@ -393,6 +393,7 @@ class GraphLoader:
         self.world = world
         self.epoch = 0
         self.group = max(1, int(group))
+        self.block = 1
 
     def set_group(self, n: int) -> None:
         """Multi-device stacking contract: the epoch loop stacks ``n``
@@ -403,6 +404,18 @@ class GraphLoader:
         paying off under a mesh instead of being force-disabled (round-3
         verdict missing #3 / weak #5)."""
         self.group = max(1, int(n))
+
+    def set_superstep(self, k: int) -> None:
+        """Superstep block contract (``train/superstep.py``): the epoch loop
+        scans ``k`` device-groups (= ``k * group`` consecutive batches) per
+        dispatch, which requires ONE bucket shape for the whole block.
+        ``batch_plan`` then reorders the epoch bucket-major: each bucket's
+        device-groups are laid out in runs of ``k`` full blocks, and the
+        leftover groups (fewer than ``k`` in some bucket) re-collate to
+        their component-wise max bucket and pack the epoch tail — so the
+        compile count stays bounded by the bucket table and no sample is
+        dropped (the trailing partial block fills with masked batches)."""
+        self.block = max(1, int(k))
 
     def _pick_bucket_totals(self, tot_n: int, tot_e: int, tot_t: int) -> PadSpec:
         for b in self.buckets:
@@ -532,7 +545,52 @@ class GraphLoader:
                 pad = self._max_spec(members)
                 for j in range(i, i + len(members)):
                     plan[j] = (plan[j][0], pad)
+        if self.block > 1 and self.buckets and len(plan) > 1:
+            plan = self._bucket_major(plan)
         return plan
+
+    def _bucket_major(self, plan):
+        """Bucket-major block scheduling (``set_superstep``): reorder the
+        epoch's device-groups so every block of ``block`` consecutive groups
+        shares ONE bucket. Deterministic given the plan, which all ranks
+        derive from the shared permutation — the reorder stays SPMD-aligned.
+        Leftover groups (per-bucket count not divisible by ``block``) move to
+        the epoch tail re-collated to the TOP bucket — not their per-epoch
+        max, which would give the tail a permutation-dependent shape and a
+        fresh compile whenever it changed; a partial trailing device-group
+        goes last so the epoch loop's masked fill stays a suffix.
+
+        Compile-boundedness: every block shape is drawn from the bucket
+        table, so each compiles at most once per run. Under ``shuffle=True``
+        a rare bucket can first reach ``block`` full groups only after epoch
+        0, landing its one compile past the sentinel's warm-up epoch (the
+        K=1 grouped path shares this property via ``_max_spec`` coarsening);
+        strict-sentinel runs on small/skewed datasets should disable shuffle
+        or use ``warn``."""
+        unit = self.group
+        units = [plan[i : i + unit] for i in range(0, len(plan), unit)]
+        partial = units.pop() if units and len(units[-1]) < unit else None
+        by_bucket: dict = {}
+        for u in units:
+            by_bucket.setdefault(u[0][1].as_tuple(), []).append(u)
+        ordered, leftover = [], []
+        for us in by_bucket.values():
+            nfull = (len(us) // self.block) * self.block
+            ordered.extend(us[:nfull])
+            leftover.extend(us[nfull:])
+        if partial is not None:
+            leftover.append(partial)
+        if leftover:
+            # component-wise max over the WHOLE table — constant per loader,
+            # so the tail shape never depends on the epoch's leftover mix
+            # (== buckets[-1] for the nested derived tables; a dominating
+            # upper bound for caller-supplied non-nested lists, since every
+            # member pad is a component-wise max of table buckets)
+            pad = self._max_spec(list(self.buckets))
+            ordered.extend(
+                [(chunk, pad) for chunk, _ in u] for u in leftover
+            )
+        return [b for u in ordered for b in u]
 
     def collate_chunk(self, chunk: np.ndarray, pad: PadSpec) -> GraphBatch:
         if hasattr(self.samples, "fetch"):
@@ -544,6 +602,56 @@ class GraphLoader:
     def __iter__(self) -> Iterable[GraphBatch]:
         for chunk, pad in self.batch_plan():
             yield self.collate_chunk(chunk, pad)
+
+
+def background_iter(iterable, depth: int = 2, init=None):
+    """Consume ``iterable`` in a daemon worker thread, buffering up to
+    ``depth`` finished items ahead of the consumer. The single shared
+    implementation of the producer/consumer machinery used by both
+    ``PrefetchLoader`` (per-batch collate + transfer) and the superstep
+    block stager (``train.superstep.double_buffer``): exceptions travel
+    through the queue and re-raise in the consumer; the worker gives up
+    promptly (0.1s put poll against a stop event) when the consumer
+    abandons the iterator; ``init`` runs once in the worker thread (core
+    pinning)."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+    stop = threading.Event()
+    done = object()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        if init is not None:
+            init()
+        try:
+            for item in iterable:
+                if not put(item):
+                    return
+            put(done)
+        except BaseException as exc:  # propagate into the consumer
+            put(exc)
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is done:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
 
 
 class PrefetchLoader:
@@ -558,13 +666,12 @@ class PrefetchLoader:
     threads.
     """
 
-    _DONE = object()
-
     def __init__(self, loader, depth: int = 2, device_put: bool = True, workers: int = 1):
         self.loader = loader
         self.depth = max(1, int(depth))
         self.device_put = device_put
         self.workers = max(1, int(workers))
+        self._superstep_k = 1
         self._reset_pins()
         # delegate loader state the epoch loop touches
         self.samples = getattr(loader, "samples", [])
@@ -576,6 +683,19 @@ class PrefetchLoader:
     def set_group(self, n: int) -> None:
         if hasattr(self.loader, "set_group"):
             self.loader.set_group(n)
+
+    def set_superstep(self, k: int) -> None:
+        """Block-granularity prefetch: delegate the bucket-major plan reorder
+        to the wrapped loader and widen the buffer to hold (at least) one
+        full K x group block ahead, so the NEXT superstep block's collate is
+        already done while the current one executes on device."""
+        self._superstep_k = max(1, int(k))
+        if hasattr(self.loader, "set_superstep"):
+            self.loader.set_superstep(k)
+
+    def _effective_depth(self) -> int:
+        blk = self._superstep_k * max(1, getattr(self.loader, "group", 1))
+        return max(self.depth, blk + 1) if blk > 1 else self.depth
 
     def __len__(self) -> int:
         return len(self.loader)
@@ -627,13 +747,14 @@ class PrefetchLoader:
 
         plan = self.loader.batch_plan()
         self._reset_pins()
+        depth = self._effective_depth()
         with ThreadPoolExecutor(
             max_workers=self.workers, initializer=self._pin_worker
         ) as ex:
             pending: deque = deque()
             it = iter(plan)
             try:
-                for _ in range(self.depth + self.workers - 1):
+                for _ in range(depth + self.workers - 1):
                     chunk_pad = next(it, None)
                     if chunk_pad is None:
                         break
@@ -652,43 +773,9 @@ class PrefetchLoader:
         if self.workers > 1 and hasattr(self.loader, "batch_plan"):
             yield from self._iter_pooled()
             return
-        import queue
-        import threading
-
-        q: queue.Queue = queue.Queue(maxsize=self.depth)
-        stop = threading.Event()
-
-        def put(item) -> bool:
-            """Blocking put that gives up when the consumer is gone."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
         self._reset_pins()
-
-        def worker():
-            self._pin_worker()
-            try:
-                for b in self.loader:
-                    if not put(self._transfer(b)):
-                        return
-                put(self._DONE)
-            except BaseException as exc:  # propagate into the consumer
-                put(exc)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is self._DONE:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            stop.set()
+        yield from background_iter(
+            (self._transfer(b) for b in self.loader),
+            depth=self._effective_depth(),
+            init=self._pin_worker,
+        )

@@ -14,6 +14,7 @@ from .step import (
     shard_state,
     stack_device_batches,
     put_batch,
+    put_block,
 )
 
 __all__ = [
@@ -30,6 +31,7 @@ __all__ = [
     "shard_state",
     "stack_device_batches",
     "put_batch",
+    "put_block",
 ]
 from .distributed import (  # noqa: E402
     setup_ddp,

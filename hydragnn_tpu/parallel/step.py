@@ -128,6 +128,22 @@ def put_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
     return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), data_sh), batch)
 
 
+def put_block(block: GraphBatch, mesh: Mesh) -> GraphBatch:
+    """Device-put a ``[K, D, ...]`` superstep block: axis 0 is the lax.scan
+    step axis (replicated — iterated on-device), axis 1 the per-device axis
+    sharded over ``data`` exactly like ``put_batch``'s leading axis.
+
+    Multi-process: each process passes its LOCAL ``[K, D_local, ...]`` stack
+    and the global array assembles shard-by-shard, same as ``put_batch``."""
+    sh = NamedSharding(mesh, P(None, DATA_AXIS))
+    if _spans_processes(mesh):
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sh, np.asarray(x)),
+            block,
+        )
+    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sh), block)
+
+
 def merge_replica_stats(new_stats, node_counts):
     """Replica-mean merge of per-replica batch_stats updates, EXCLUDING
     replicas that saw zero real nodes. A plain mean would hand a FILL

@@ -230,20 +230,30 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     workers = flags.get(
         flags.NUM_WORKERS, default=int(training_cfg.get("num_workers", 1))
     )
+    # supersteps (Training.steps_per_dispatch / HYDRAGNN_SUPERSTEP) stack K
+    # host batches into one [K, ...] block in the loop — read K here so the
+    # prefetcher knows to keep batches host-side for stacking
+    from .train.superstep import resolve_steps_per_dispatch
+
+    k_dispatch = resolve_steps_per_dispatch(training_cfg)
     if depth > 0:
         from .graphs.batching import PrefetchLoader
 
-        # under a mesh the loop stacks host batches itself: prefetch the
-        # collate work but leave device placement to put_batch
-        dput = mesh is None
+        # under a mesh (or a superstep block) the loop stacks host batches
+        # itself: prefetch the collate work but leave device placement to
+        # put_batch / put_block. Supersteps only ever consume the TRAIN
+        # loader as blocks — eval stays per-batch, so val/test keep the
+        # prefetched device_put at any K
+        dput_eval = mesh is None
         train_loader = PrefetchLoader(
-            train_loader, depth=depth, device_put=dput, workers=workers
+            train_loader, depth=depth,
+            device_put=dput_eval and k_dispatch == 1, workers=workers
         )
         val_loader = PrefetchLoader(
-            val_loader, depth=depth, device_put=dput, workers=workers
+            val_loader, depth=depth, device_put=dput_eval, workers=workers
         )
         test_loader = PrefetchLoader(
-            test_loader, depth=depth, device_put=dput, workers=workers
+            test_loader, depth=depth, device_put=dput_eval, workers=workers
         )
 
     state = train_validate_test(

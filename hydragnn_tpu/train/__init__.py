@@ -6,6 +6,7 @@ from .step import (
     make_predict_step,
     resolve_precision,
 )
+from .superstep import make_superstep, double_buffer
 from .optimizer import select_optimizer, ReduceLROnPlateau, get_learning_rate, set_learning_rate
 from .loop import train_validate_test, train_epoch, evaluate, test
 from .checkpoint import save_checkpoint, load_checkpoint, Checkpoint, EarlyStopping
@@ -17,6 +18,8 @@ __all__ = [
     "make_eval_step",
     "make_predict_step",
     "resolve_precision",
+    "make_superstep",
+    "double_buffer",
     "select_optimizer",
     "ReduceLROnPlateau",
     "get_learning_rate",

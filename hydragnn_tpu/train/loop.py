@@ -81,6 +81,40 @@ def _grouped(loader, n: int, mesh, fill: bool = False, put=None):
         yield put(stack_device_batches(group), mesh)
 
 
+def _blocked(loader, k: int, n_dev: int, mesh):
+    """Group k*n_dev consecutive batches into ONE ``[K(, D), ...]`` superstep
+    block. Fill semantics extend ``_grouped``: the trailing partial block pads
+    with empty (all-masked) batches, which carry zero loss/stat weight AND
+    zero state change (the superstep select-skips their optimizer update), so
+    no loader batch is dropped and the final state bit-matches training on
+    only the real batches."""
+    group = []
+    for b in loader:
+        group.append(b)
+        if len(group) == k * n_dev:
+            yield _stage_block(group, k, n_dev, mesh)
+            group = []
+    if group:
+        group.extend([_empty_like(group[0])] * (k * n_dev - len(group)))
+        yield _stage_block(group, k, n_dev, mesh)
+
+
+def _stage_block(batches, k: int, n_dev: int, mesh):
+    """Stack k*n_dev host batches into one scan block and place it: with a
+    mesh, axis 0 is the (on-device, iterated) scan axis and axis 1 the
+    data-sharded device axis; single-device blocks are just ``[K, ...]``."""
+    from ..parallel.step import put_block, stack_device_batches
+
+    if mesh is not None:
+        steps = [
+            stack_device_batches(batches[i * n_dev : (i + 1) * n_dev])
+            for i in range(k)
+        ]
+        return put_block(stack_device_batches(steps), mesh)  # [K, D, ...]
+    block = stack_device_batches(batches)  # [K, ...]
+    return jax.tree.map(jnp.asarray, block)
+
+
 _SENTINEL = object()
 
 
@@ -119,21 +153,27 @@ def _backpressure(step_metrics: list) -> None:
 
 def _accumulate(step_metrics: list, extra_keys: tuple = ()):
     """Graph-count-weighted reduction of an epoch's metrics — ONE batched
-    device-to-host fetch for everything, then pure numpy."""
+    device-to-host fetch for everything, then pure numpy. Accepts both
+    per-step metric dicts (scalar ``num_graphs``) and superstep-stacked ones
+    (leading ``[K]`` axis from the ``lax.scan`` dispatch)."""
     step_metrics = jax.device_get(step_metrics)
     tot = 0.0
     tasks = None
     n_graphs = 0.0
     extras = {k: None for k in extra_keys}
     for m in step_metrics:
-        g = float(m["num_graphs"])
-        tot += float(m["loss"]) * g
-        t = np.asarray(m["tasks_loss"], np.float64) * g
+        g = np.atleast_1d(np.asarray(m["num_graphs"], np.float64))  # [K]
+        loss = np.atleast_1d(np.asarray(m["loss"], np.float64))
+        tot += float((loss * g).sum())
+        t = np.asarray(m["tasks_loss"], np.float64).reshape(g.shape[0], -1)
+        t = (t * g[:, None]).sum(axis=0)
         tasks = t if tasks is None else tasks + t
         for k in extra_keys:
             v = np.asarray(m[k], np.float64)
+            if g.shape[0] > 1:  # stacked: per-step rows sum (already counts)
+                v = v.reshape(g.shape[0], -1).sum(axis=0)
             extras[k] = v if extras[k] is None else extras[k] + v
-        n_graphs += g
+        n_graphs += float(g.sum())
     denom = max(n_graphs, 1.0)
     return (
         tot / denom,
@@ -144,29 +184,49 @@ def _accumulate(step_metrics: list, extra_keys: tuple = ()):
 
 def train_epoch(
     train_step, state: TrainState, loader, verbosity: int = 0, mesh=None,
-    put_fn=None, group_n=None, group_put=None,
+    put_fn=None, group_n=None, group_put=None, steps_per_dispatch: int = 1,
 ):
     """One training epoch; returns (state, mean loss, per-task mean losses).
     ``put_fn`` (edge-sharded mode) transfers each batch itself — no device
     grouping; every step consumes ONE batch sharded across the mesh.
     ``group_n``/``group_put`` override the grouped path's stack size and
-    placement (pipeline mode: n_micro microbatches, replicated)."""
+    placement (pipeline mode: n_micro microbatches, replicated).
+    ``steps_per_dispatch`` (K>1): ``train_step`` must be the matching
+    ``make_superstep(step, K)`` dispatch — each iteration consumes a
+    ``[K(, n_dev), ...]`` block of K*n_dev loader batches."""
     nbatch = _max_num_batches(loader)
     grouped = mesh is not None and put_fn is None
     n_dev = (group_n or _local_device_count(mesh)) if grouped else 1
-    if grouped:
+    k = max(1, int(steps_per_dispatch))
+    if k > 1 and (put_fn is not None or group_put is not None):
+        raise ValueError(
+            "steps_per_dispatch > 1 is not supported with a per-batch "
+            "put_fn or a group placement override (edge-sharded and "
+            "pipeline modes pin K=1)"
+        )
+    per_dispatch = k * n_dev
+    if per_dispatch > 1:
         # the HYDRAGNN_MAX_NUM_BATCH cap counts raw loader batches; each
-        # grouped step consumes n_dev of them
-        nbatch = max(1, -(-nbatch // n_dev))
-    it = _timed_iter(
-        # fill=True: the trailing partial device group trains too, padded
-        # with all-masked batches (zero loss weight, zero grad, zero stat
-        # weight) — previously up to n_dev-1 loader batches per epoch were
-        # silently never trained on (round-4 verdict weak #4)
-        _grouped(loader, n_dev, mesh, fill=True, put=group_put)
-        if grouped
-        else iterate_tqdm(loader, verbosity, desc="train", total=nbatch)
-    )
+        # dispatch consumes k*n_dev of them (rounded up to whole dispatches)
+        nbatch = max(1, -(-nbatch // per_dispatch))
+    if k > 1:
+        from .superstep import double_buffer
+
+        # block staging (K-stack + device placement) happens one block ahead
+        # in a worker thread, overlapping the current superstep's execution
+        it = _timed_iter(double_buffer(_blocked(loader, k, n_dev, mesh)))
+    elif grouped:
+        it = _timed_iter(
+            # fill=True: the trailing partial device group trains too, padded
+            # with all-masked batches (zero loss weight, zero grad, zero stat
+            # weight) — previously up to n_dev-1 loader batches per epoch were
+            # silently never trained on (round-4 verdict weak #4)
+            _grouped(loader, n_dev, mesh, fill=True, put=group_put)
+        )
+    else:
+        it = _timed_iter(
+            iterate_tqdm(loader, verbosity, desc="train", total=nbatch)
+        )
     step_metrics = []  # on-device until the epoch ends (see _MAX_IN_FLIGHT)
     tr.start("train")
     for ib, batch in enumerate(it):
@@ -174,7 +234,7 @@ def train_epoch(
             break
         if put_fn is not None:
             batch = put_fn(batch)
-        elif mesh is None:
+        elif mesh is None and k == 1:
             batch = jax.tree.map(jnp.asarray, batch)
         state, metrics = train_step(state, batch)
         step_metrics.append(metrics)
@@ -318,6 +378,36 @@ def train_validate_test(
         train_step = make_train_step(model, optimizer, compute_dtype=precision)
         eval_step = make_eval_step(model, compute_dtype=precision)
 
+    # Device-resident supersteps (Training.steps_per_dispatch /
+    # HYDRAGNN_SUPERSTEP): fold K train steps into one lax.scan dispatch so
+    # the host touches the device once per K batches. Edge-sharded and
+    # pipeline modes pin K=1 — both place each batch with a custom per-batch
+    # transfer whose sharding has no stacked [K, ...] equivalent yet.
+    from .superstep import resolve_steps_per_dispatch
+
+    k_dispatch = resolve_steps_per_dispatch(training)
+    if k_dispatch > 1 and (put_fn is not None or group_put is not None):
+        print_distributed(
+            verbosity,
+            f"supersteps requested (K={k_dispatch}) but edge-sharded/pipeline "
+            "mode is active: pinning K=1",
+        )
+        k_dispatch = 1
+    if k_dispatch > 1:
+        from .superstep import make_superstep, state_shardings
+
+        # pin carry-out shardings to the incoming state's layout on a mesh:
+        # otherwise the partitioner may re-shard the carry on dispatch 1 and
+        # the re-keyed cache entry compiles on dispatch 2 — which lands in
+        # epoch 1 (tripping the strict sentinel) when K folds a small epoch
+        # into a single dispatch
+        carry_sh = state_shardings(state) if mesh is not None else None
+        dispatch_step = make_superstep(
+            train_step, k_dispatch, carry_shardings=carry_sh
+        )
+    else:
+        dispatch_step = train_step
+
     scheduler = ReduceLROnPlateau(get_learning_rate(state.opt_state))
     checkpoint = (
         Checkpoint(log_name, warmup=int(training.get("checkpoint_warmup", 0)))
@@ -337,6 +427,12 @@ def train_validate_test(
         for ld in (train_loader, val_loader, test_loader):
             if hasattr(ld, "set_group"):
                 ld.set_group(n_stack)
+    # superstep block contract (train loader only — eval stays per-batch):
+    # bucket-major block scheduling reorders each epoch's plan so every
+    # K x n_dev block collates to ONE pad bucket, keeping the compile count
+    # bounded by the bucket table
+    if k_dispatch > 1 and hasattr(train_loader, "set_superstep"):
+        train_loader.set_superstep(k_dispatch)
 
     skip_valtest = not flags.get(flags.VALTEST)
     # a dataset too small (or perc_train=1.0) can leave val/test empty —
@@ -399,8 +495,9 @@ def train_validate_test(
             lowerings_at_epoch_start = compile_counts()["lowerings"]
         train_loader.set_epoch(epoch)
         state, train_loss, train_tasks = train_epoch(
-            train_step, state, train_loader, verbosity, mesh=mesh, put_fn=put_fn,
-            group_n=group_n, group_put=group_put,
+            dispatch_step, state, train_loader, verbosity, mesh=mesh,
+            put_fn=put_fn, group_n=group_n, group_put=group_put,
+            steps_per_dispatch=k_dispatch,
         )
         if profiling and epoch == 0:
             _profiler("stop")
@@ -468,7 +565,16 @@ def train_validate_test(
     return state
 
 
-def test(eval_step, state: TrainState, loader, verbosity: int = 0):
+def test(
+    eval_step, state: TrainState, loader, verbosity: int = 0,
+    mesh=None, put_fn=None, group_n=None, group_put=None,
+):
     """Reference ``test()`` (``train_validate_test.py:875-1090``): returns
-    (total error, per-task losses, per-head rmse)."""
-    return evaluate(eval_step, state, loader, verbosity, span="test")
+    (total error, per-task losses, per-head rmse). Threads the mesh/placement
+    kwargs through like ``train_validate_test`` does — a standalone test()
+    call on a mesh-trained state must evaluate with the same device grouping,
+    not silently un-grouped."""
+    return evaluate(
+        eval_step, state, loader, verbosity, span="test",
+        mesh=mesh, put_fn=put_fn, group_n=group_n, group_put=group_put,
+    )

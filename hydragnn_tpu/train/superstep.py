@@ -1,0 +1,147 @@
+"""Device-resident supersteps: fold K train steps into ONE host dispatch.
+
+The reference training loop (``hydragnn/train/train_validate_test.py:678-801``)
+dispatches one program per batch from Python. On TPU that leaves the chip idle
+between steps whenever host collate + dispatch latency exceeds step time —
+exactly the regime small per-graph GNN steps live in (the r5 per-arch sweep
+measured sub-10ms steps for GIN/SAGE/MFC). The canonical JAX fix: wrap the
+per-batch train step in a ``lax.scan`` over a ``[K, ...]``-stacked block of
+batches, carrying a donated ``TrainState``, so the host touches the device
+once per K batches instead of once per batch.
+
+Contracts (enforced by ``tests/test_superstep.py``):
+
+* **Exact parity** — K scanned steps produce bit-identical params/opt-state/
+  metrics to K individual ``train_step`` calls on the same batches (fp32;
+  bf16 allclose). The scan body inlines the very same step program; nothing
+  is reassociated across steps.
+* **Fill skip** — an all-masked fill batch (``loop._empty_like``, used to pad
+  the trailing partial block) contributes zero loss weight AND zero state
+  change: the scan body select-skips the optimizer update when the step saw
+  zero real graphs. Without the skip, AdamW's weight decay + EMA decay would
+  drift params on zero-gradient steps and the trailing block would diverge
+  from the K=1 path.
+* **Compile boundedness** — one program per (bucket shape, K); the loader's
+  bucket-major block scheduling (``GraphLoader.set_superstep``) guarantees
+  every block is collated to a single pad bucket, so the program count stays
+  bounded by the bucket table and ``HYDRAGNN_COMPILE_SENTINEL=strict`` holds.
+
+Edge-sharded and pipeline modes pin K=1 for now: both place *each batch*
+with a custom transfer function (``put_large_batch`` / ``put_microbatches``)
+whose per-batch sharding has no stacked ``[K, ...]`` equivalent yet.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .step import donate_state_argnums
+
+
+def resolve_steps_per_dispatch(training_cfg: dict) -> int:
+    """The single resolver for K (shared by ``run_training``'s staging
+    decisions and ``train_validate_test``'s dispatch routing, so the two
+    can't drift): ``HYDRAGNN_SUPERSTEP`` overrides
+    ``Training.steps_per_dispatch``; unset/0/1 disables. Mode-specific
+    pinning (edge-sharded / pipeline → K=1) stays in
+    ``train_validate_test``, where the modes are known."""
+    from ..utils import flags
+
+    k = flags.get(
+        flags.SUPERSTEP,
+        default=int(training_cfg.get("steps_per_dispatch", 1) or 1),
+    )
+    return max(1, int(k))
+
+
+_NO_CONSTRAINT = object()
+
+
+def state_shardings(state):
+    """Carry-sharding pins for ``make_superstep`` (mesh path): the input
+    state's per-leaf ``NamedSharding``s. Without the pin, the partitioner is
+    free to re-shard the scanned carry's outputs (e.g. tiny replicated params
+    across the data axis) on the FIRST dispatch — the second dispatch then
+    sees differently-sharded inputs and compiles a second program. With one
+    dispatch per epoch (small epochs, large K) that second compile lands in
+    epoch 1 and trips ``HYDRAGNN_COMPILE_SENTINEL=strict``. Non-array leaves
+    (and uncommitted host arrays) pass through unconstrained."""
+    from jax.sharding import NamedSharding
+
+    def one(x):
+        sh = getattr(x, "sharding", None)
+        return sh if isinstance(sh, NamedSharding) else _NO_CONSTRAINT
+
+    return jax.tree.map(one, state)
+
+
+def make_superstep(
+    train_step: Callable, k: int, donate_argnums=None, carry_shardings=None
+) -> Callable:
+    """Wrap a jitted ``(state, batch) -> (state, metrics)`` train step into a
+    ``(state, block) -> (state, stacked_metrics)`` superstep that runs ``k``
+    steps on-device per dispatch.
+
+    ``block`` is the batch pytree with a leading ``[k, ...]`` axis (built by
+    ``loop._blocked``); ``stacked_metrics`` carries a leading ``[k]`` axis and
+    drops straight into the epoch loop's ``_accumulate``/backpressure
+    machinery as one pytree per dispatch.
+
+    The carry is donated on accelerators (same policy as the per-batch step:
+    ``donate_state_argnums``), so K steps reuse one set of state buffers.
+    ``carry_shardings`` (see :func:`state_shardings`) pins the carry-out
+    layout to the carry-in layout so the jit cache stays single-entry.
+    """
+    k = int(k)
+    if k <= 1:
+        return train_step
+    donate = donate_state_argnums() if donate_argnums is None else donate_argnums
+
+    def body(carry, batch):
+        new_state, metrics = train_step(carry, batch)
+        # Fill-batch skip: a step that saw ZERO real graphs (an all-masked
+        # _empty_like pad in the trailing partial block) must not touch the
+        # state — optimizer decay/weight-decay on a zero gradient is not a
+        # no-op, and the step counter drives the dropout rng fold. The
+        # select keeps the whole block one static program.
+        real = metrics["num_graphs"] > 0
+        new_state = jax.tree.map(
+            lambda n, o: jnp.where(real, n, o), new_state, carry
+        )
+        return new_state, metrics
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def superstep(state, block):
+        state, metrics = jax.lax.scan(body, state, block, length=k)
+        if carry_shardings is not None:
+            state = jax.tree.map(
+                lambda x, s: x if s is _NO_CONSTRAINT
+                else jax.lax.with_sharding_constraint(x, s),
+                state, carry_shardings,
+            )
+        return state, metrics
+
+    return superstep
+
+
+def double_buffer(iterable, depth: int = 2):
+    """Run ``iterable`` (block staging: collate-stack + ``device_put``) in a
+    worker thread ``depth`` items ahead of the consumer, so the next block's
+    host work overlaps the current superstep's device execution.
+
+    The per-batch path gets this overlap from ``PrefetchLoader``; blocks need
+    it again because stacking K batches and placing the ``[K, ...]`` array
+    happens *after* the prefetcher. Thin front for the shared
+    ``graphs.batching.background_iter`` machinery (exception propagation,
+    prompt worker shutdown when the consumer abandons the iterator).
+    """
+    from ..graphs.batching import background_iter
+
+    return background_iter(iterable, depth=depth)
+
+
+__all__ = ["make_superstep", "double_buffer"]

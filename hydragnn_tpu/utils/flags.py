@@ -47,6 +47,15 @@ MAX_NUM_BATCH = _register(Flag(
     "HYDRAGNN_MAX_NUM_BATCH", "int", None,
     "Cap batches per epoch (reference train_validate_test.py:179; pins "
     "work for scaling runs)."))
+SUPERSTEP = _register(Flag(
+    "HYDRAGNN_SUPERSTEP", "int", None,
+    "Train steps folded into ONE device dispatch via lax.scan (overrides "
+    "Training.steps_per_dispatch; unset/1 disables). K>1 amortizes host "
+    "dispatch latency over K steps — the win grows as steps get shorter — "
+    "at the cost of device memory for the in-flight K-batch block plus up "
+    "to 2 more staged ahead (~3K batches) and coarser (K-step) metric "
+    "granularity. Edge-sharded and pipeline modes pin K=1 (their "
+    "per-batch placement has no stacked [K, ...] equivalent yet)."))
 DUMP_TESTDATA = _register(Flag(
     "HYDRAGNN_DUMP_TESTDATA", "bool", False,
     "Dump per-rank test true/pred pickles (reference :908)."))

@@ -1,0 +1,23 @@
+"""GL003 clean fixture: lax.scan-folded steps are the SANCTIONED alternative
+to jit-in-loop — one jitted superstep built outside the loop scans K steps
+per dispatch, so neither the scan nor the epoch loop rebuilds a jit wrapper
+per iteration (the pattern ``train/superstep.py`` wires into the epoch loop).
+"""
+import functools
+
+import jax
+
+
+def make_superstep(step_fn, k):
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def superstep(state, block):
+        return jax.lax.scan(step_fn, state, block, length=k)
+
+    return superstep
+
+
+def train(state, blocks, step_fn, k):
+    superstep = make_superstep(step_fn, k)  # hoisted: built once
+    for block in blocks:
+        state, _ = superstep(state, block)  # scan folds K steps per dispatch
+    return state

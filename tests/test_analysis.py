@@ -143,6 +143,15 @@ def test_explicit_missing_baseline_is_an_error():
 # -- baseline machinery ------------------------------------------------------
 
 
+def test_gl003_scan_folded_steps_are_clean():
+    """lax.scan-folded supersteps (train/superstep.py's pattern: one jitted
+    scan built outside the loop, dispatched per block) are the sanctioned
+    alternative to jit-in-loop — GL003 (and every other rule) must not flag
+    them."""
+    findings = analyze([str(FIXTURES / "gl003_scan_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_gl003_nested_loop_reports_once(tmp_path):
     p = tmp_path / "nested.py"
     p.write_text(

@@ -1,0 +1,403 @@
+"""Device-resident superstep tests (ISSUE 4, ``train/superstep.py``).
+
+The correctness bar is EXACT: K scanned steps must reproduce K individual
+steps on the same batches — params, opt state, and metrics — pinned for fp32
+(bit-identical) and bf16 (allclose), with and without a mesh. Plus the
+scheduling contracts: bucket-major blocks stay single-bucket, masked fill
+batches leave the state untouched, HYDRAGNN_MAX_NUM_BATCH keeps counting raw
+loader batches, and a 2-epoch bucketed run stays compile-stable.
+"""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.datasets import deterministic_graph_data
+from hydragnn_tpu.graphs.batching import GraphLoader, PrefetchLoader, collate, compute_pad_spec
+from hydragnn_tpu.models import create_model_config
+from hydragnn_tpu.parallel import (
+    make_mesh,
+    make_parallel_train_step,
+    put_batch,
+    put_block,
+    shard_state,
+    stack_device_batches,
+)
+from hydragnn_tpu.preprocess import apply_variables_of_interest
+from hydragnn_tpu.train import (
+    create_train_state,
+    make_superstep,
+    make_train_step,
+    select_optimizer,
+)
+from hydragnn_tpu.train.loop import _accumulate, _empty_like, train_epoch, train_validate_test
+
+from test_config import CI_CONFIG
+
+
+def setup_model(n_samples=64, batch=4):
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=n_samples, seed=9)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    opt = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    pad = compute_pad_spec(samples, batch)
+    batches = [
+        collate(samples[i * batch : (i + 1) * batch], pad)
+        for i in range(len(samples) // batch)
+    ]
+    return cfg, model, opt, batches, samples
+
+
+def _state_leaves(state):
+    return [np.asarray(x) for x in jax.tree.leaves(state)]
+
+
+def assert_states_equal(a, b, exact=True, atol=0.0):
+    la, lb = _state_leaves(a), _state_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        if exact:
+            assert np.array_equal(x, y), "state leaf diverged"
+        else:
+            np.testing.assert_allclose(
+                np.asarray(x, np.float32), np.asarray(y, np.float32), atol=atol
+            )
+
+
+def _stack_k(batches):
+    return jax.tree.map(jnp.asarray, stack_device_batches(batches))
+
+
+def test_superstep_fp32_exact_parity_single_device():
+    """K scanned steps == K individual steps, bit for bit (params, opt
+    state, per-step metrics)."""
+    _, model, opt, batches, _ = setup_model()
+    step = make_train_step(model, opt)
+    K = 4
+    state0 = create_train_state(model, opt, batches[0])
+
+    s_ref = state0
+    ref_metrics = []
+    for b in batches[:K]:
+        s_ref, m = step(s_ref, jax.tree.map(jnp.asarray, b))
+        ref_metrics.append(m)
+
+    superstep = make_superstep(step, K)
+    s_sup, m_sup = superstep(state0, _stack_k(batches[:K]))
+
+    assert_states_equal(s_ref, s_sup, exact=True)
+    for i in range(K):
+        assert float(ref_metrics[i]["loss"]) == float(m_sup["loss"][i])
+        assert float(ref_metrics[i]["num_graphs"]) == float(m_sup["num_graphs"][i])
+        np.testing.assert_array_equal(
+            np.asarray(ref_metrics[i]["tasks_loss"]),
+            np.asarray(m_sup["tasks_loss"][i]),
+        )
+
+
+def test_superstep_bf16_allclose_single_device():
+    _, model, opt, batches, _ = setup_model()
+    step = make_train_step(model, opt, compute_dtype=jnp.bfloat16)
+    K = 3
+    state0 = create_train_state(model, opt, batches[0])
+    s_ref = state0
+    for b in batches[:K]:
+        s_ref, m_ref = step(s_ref, jax.tree.map(jnp.asarray, b))
+    superstep = make_superstep(step, K)
+    s_sup, m_sup = superstep(state0, _stack_k(batches[:K]))
+    # fp32 master params, bf16 compute: tiny cross-program fusion jitter only
+    for x, y in zip(_state_leaves(s_ref), _state_leaves(s_sup)):
+        if np.issubdtype(np.asarray(x).dtype, np.floating):
+            np.testing.assert_allclose(x, y, rtol=2e-2, atol=2e-2)
+        else:
+            np.testing.assert_array_equal(x, y)
+    np.testing.assert_allclose(
+        float(m_ref["loss"]), float(m_sup["loss"][-1]), rtol=2e-2
+    )
+
+
+def test_superstep_mesh_parity_8dev():
+    """Same contract on the virtual 8-device CPU mesh: a [K, D, ...] block
+    through one scanned SPMD dispatch == K grouped SPMD steps."""
+    _, model, opt, batches, _ = setup_model()
+    mesh = make_mesh()
+    assert mesh.shape["data"] == 8
+    K = 2
+    par = make_parallel_train_step(model, opt, mesh)
+    state0 = create_train_state(model, opt, batches[0])
+
+    s_ref = shard_state(state0, mesh)
+    ref_losses = []
+    for i in range(K):
+        sb = put_batch(stack_device_batches(batches[i * 8 : (i + 1) * 8]), mesh)
+        s_ref, m = par(s_ref, sb)
+        ref_losses.append(float(m["loss"]))
+
+    superstep = make_superstep(par, K)
+    steps = [
+        stack_device_batches(batches[i * 8 : (i + 1) * 8]) for i in range(K)
+    ]
+    block = put_block(stack_device_batches(steps), mesh)
+    s_sup, m_sup = superstep(shard_state(state0, mesh), block)
+
+    assert_states_equal(s_ref, s_sup, exact=True)
+    assert ref_losses == [float(x) for x in np.asarray(m_sup["loss"])]
+
+
+def test_trailing_fill_is_bit_identical_to_real_only():
+    """ISSUE 4 satellite: a trailing partial block (real + _empty_like
+    masked batches) must yield BIT-identical state to training on only the
+    real batches — the scan body select-skips the optimizer update when a
+    step saw zero real graphs (AdamW decay on a zero gradient is not a
+    no-op)."""
+    _, model, opt, batches, _ = setup_model()
+    step = make_train_step(model, opt)
+    K = 4
+    n_real = 3
+    state0 = create_train_state(model, opt, batches[0])
+
+    s_ref = state0
+    for b in batches[:n_real]:
+        s_ref, _ = step(s_ref, jax.tree.map(jnp.asarray, b))
+
+    superstep = make_superstep(step, K)
+    fill = [_empty_like(batches[0])] * (K - n_real)
+    s_sup, m_sup = superstep(state0, _stack_k(batches[:n_real] + fill))
+
+    assert_states_equal(s_ref, s_sup, exact=True)
+    g = np.asarray(m_sup["num_graphs"])
+    assert g[n_real:].sum() == 0.0  # fill steps carry zero metric weight
+    # and the loop's weighted accumulate ignores them entirely
+    loss_sup, _, _ = _accumulate([m_sup])
+    ref_metrics = []
+    s = state0
+    for b in batches[:n_real]:
+        s, m = step(s, jax.tree.map(jnp.asarray, b))
+        ref_metrics.append(m)
+    loss_ref, _, _ = _accumulate(ref_metrics)
+    assert loss_sup == loss_ref
+
+
+def test_train_epoch_superstep_matches_k1(tmp_path):
+    """train_epoch with steps_per_dispatch=K (block staging, double buffer,
+    stacked-metric accumulate) reproduces the K=1 epoch exactly."""
+    _, model, opt, batches, _ = setup_model()
+    step = make_train_step(model, opt)
+    state0 = create_train_state(model, opt, batches[0])
+
+    s1, loss1, tasks1 = train_epoch(step, state0, list(batches))
+    K = 4
+    s2, loss2, tasks2 = train_epoch(
+        make_superstep(step, K), state0, list(batches), steps_per_dispatch=K
+    )
+    # the epoch mean sums identical fp64 per-step terms, but block-wise
+    # partial sums reassociate the addition — identical to ~1e-15 relative
+    np.testing.assert_allclose(loss1, loss2, rtol=1e-12)
+    np.testing.assert_allclose(tasks1, tasks2, rtol=1e-12)
+    assert_states_equal(s1, s2, exact=True)
+
+
+def test_train_epoch_superstep_partial_tail_matches_k1():
+    """10 batches, K=4: two full blocks + one 2-real/2-fill block must match
+    10 individual steps bit-for-bit (fill steps are select-skipped)."""
+    _, model, opt, batches, _ = setup_model()
+    step = make_train_step(model, opt)
+    state0 = create_train_state(model, opt, batches[0])
+    ten = list(batches[:10])
+    s1, loss1, _ = train_epoch(step, state0, ten)
+    s2, loss2, _ = train_epoch(
+        make_superstep(step, 4), state0, ten, steps_per_dispatch=4
+    )
+    np.testing.assert_allclose(loss1, loss2, rtol=1e-12)
+    assert_states_equal(s1, s2, exact=True)
+
+
+def _counting(step_fn):
+    calls = []
+
+    def wrapped(state, batch):
+        calls.append(1)
+        return step_fn(state, batch)
+
+    return wrapped, calls
+
+
+def test_max_num_batch_counts_raw_batches_under_supersteps(monkeypatch):
+    """HYDRAGNN_MAX_NUM_BATCH caps RAW loader batches, not dispatches: cap=5
+    with K=2 runs ceil(5/2)=3 superstep dispatches (= 6 raw batches trained)
+    — if the cap counted blocks it would run 5 dispatches (10 raw)."""
+    _, model, opt, batches, _ = setup_model()
+    step = make_train_step(model, opt)
+    state0 = create_train_state(model, opt, batches[0])
+    monkeypatch.setenv("HYDRAGNN_MAX_NUM_BATCH", "5")
+
+    sup, sup_calls = _counting(make_superstep(step, 2))
+    train_epoch(sup, state0, list(batches), steps_per_dispatch=2)  # 16 avail
+    assert len(sup_calls) == 3  # ceil(5 raw / 2 per dispatch), not 5 blocks
+
+    one, one_calls = _counting(step)
+    train_epoch(one, state0, list(batches))
+    assert len(one_calls) == 5  # same cap in raw units at K=1
+
+
+def test_two_epoch_bucketed_superstep_compile_stable(monkeypatch, tmp_path):
+    """ISSUE 4 acceptance: pad_buckets + supersteps compile nothing new after
+    epoch 0 — HYDRAGNN_COMPILE_SENTINEL=strict must stay green for 2 epochs
+    (bucket-major blocks keep the program count bounded by the bucket
+    table)."""
+    monkeypatch.setenv("HYDRAGNN_COMPILE_SENTINEL", "strict")
+    monkeypatch.chdir(tmp_path)
+    cfg, model, opt, _, samples = setup_model(n_samples=80)
+    nn = copy.deepcopy(cfg["NeuralNetwork"])
+    nn["Training"]["num_epoch"] = 2
+    nn["Training"]["steps_per_dispatch"] = 3
+
+    train_loader = GraphLoader(samples[:64], 4, shuffle=False, buckets=3)
+    assert len(train_loader.buckets) >= 2  # the test must exercise >1 bucket
+    val_loader = GraphLoader(samples[64:72], 4)
+    test_loader = GraphLoader(samples[72:], 4)
+    state = create_train_state(model, opt, next(iter(train_loader)))
+    # strict sentinel raises RecompileError on any post-warmup compile
+    train_validate_test(
+        model, opt, state, train_loader, val_loader, test_loader,
+        nn, "superstep_sentinel", verbosity=0,
+    )
+
+
+def test_mesh_superstep_carry_sharding_stays_compile_stable(compile_sentinel):
+    """K folding a SMALL epoch into one dispatch must not push a second
+    compile past the warm-up: without the carry-sharding pin, GSPMD may
+    re-shard the scanned carry's outputs on dispatch 1, and dispatch 2 (=
+    epoch 1) compiles against the new input layout."""
+    from hydragnn_tpu.train.superstep import state_shardings
+
+    _, model, opt, batches, _ = setup_model()
+    mesh = make_mesh()
+    par = make_parallel_train_step(model, opt, mesh)
+    state = shard_state(create_train_state(model, opt, batches[0]), mesh)
+    K = 2
+    superstep = make_superstep(par, K, carry_shardings=state_shardings(state))
+
+    def block(i):
+        steps = [
+            stack_device_batches(batches[j * 8 : (j + 1) * 8])
+            for j in range(i * K, i * K + K)
+        ]
+        return put_block(stack_device_batches(steps), mesh)
+
+    b0, b1 = block(0), block(0)  # build inputs OUTSIDE the guarded region
+    state, _ = superstep(state, b0)  # warm-up dispatch (epoch 0)
+    with compile_sentinel(max_compiles=0, what="superstep dispatch 2"):
+        state, _ = superstep(state, b1)
+
+
+def test_bucket_major_plan_blocks_are_single_bucket():
+    """Every K x group block in the reordered plan draws from ONE bucket, and
+    the epoch still covers every sample exactly once."""
+    _, _, _, _, samples = setup_model(n_samples=80)
+    loader = GraphLoader(samples, 4, shuffle=True, buckets=3)
+    assert len(loader.buckets) >= 2
+    K = 3
+    loader.set_superstep(K)
+    for epoch in (0, 1):
+        loader.set_epoch(epoch)
+        plan = loader.batch_plan()
+        pads = [p.as_tuple() for _, p in plan]
+        blocks = [pads[i : i + K] for i in range(0, len(pads), K)]
+        assert all(len(set(b)) == 1 for b in blocks)
+        covered = sorted(int(i) for chunk, _ in plan for i in chunk)
+        assert covered == list(range(len(samples)))
+
+
+def test_bucket_major_plan_with_device_groups():
+    """group=2 (mesh stacking) composes with block=2: blocks of group*K
+    consecutive batches stay single-bucket and group alignment is preserved
+    (a partial device group, if any, is the plan suffix)."""
+    _, _, _, _, samples = setup_model(n_samples=80)
+    loader = GraphLoader(samples, 4, shuffle=True, buckets=3)
+    loader.set_group(2)
+    loader.set_superstep(2)
+    plan = loader.batch_plan()
+    pads = [p.as_tuple() for _, p in plan]
+    step = 2 * 2  # group * K
+    for i in range(0, (len(pads) // step) * step, step):
+        assert len(set(pads[i : i + step])) == 1
+    covered = sorted(int(i) for chunk, _ in plan for i in chunk)
+    assert covered == list(range(len(samples)))
+
+
+def test_bucket_major_leftover_tail_uses_top_bucket():
+    """The leftover tail re-pads to the TOP bucket — a per-epoch max would
+    give the tail a permutation-dependent shape (a fresh compile whenever
+    the leftover mix changes)."""
+    _, _, _, _, samples = setup_model(n_samples=80)
+    loader = GraphLoader(samples, 4, shuffle=True, buckets=3)
+    loader.set_superstep(3)
+    table = {b.as_tuple() for b in loader.buckets}
+    top = loader.buckets[-1].as_tuple()
+    for epoch in (0, 1, 2):
+        loader.set_epoch(epoch)
+        plan = loader.batch_plan()
+        pads = [p.as_tuple() for _, p in plan]
+        # every block shape comes from the table (nothing epoch-synthesized)
+        assert set(pads) <= table
+        # non-top buckets appear ONLY as full K-blocks; their leftovers were
+        # re-padded to top, so the tail's shape is epoch-independent
+        for t in set(pads) - {top}:
+            assert pads.count(t) % 3 == 0
+        assert pads[-1] == top  # the fill suffix always lands on top
+
+
+def test_train_epoch_rejects_k_gt_1_with_placement_overrides():
+    """Pipeline's group_put (and edge-sharded's put_fn) expect per-batch
+    placement — K>1 must fail loudly, not hand them a [K, ...] block."""
+    _, model, opt, batches, _ = setup_model()
+    step = make_train_step(model, opt)
+    state = create_train_state(model, opt, batches[0])
+    with pytest.raises(ValueError, match="pin K=1"):
+        train_epoch(step, state, list(batches), steps_per_dispatch=2,
+                    put_fn=lambda b: b)
+    with pytest.raises(ValueError, match="pin K=1"):
+        train_epoch(step, state, list(batches), steps_per_dispatch=2,
+                    mesh=make_mesh(), group_n=2, group_put=lambda b, m: b)
+
+
+def test_prefetch_loader_delegates_superstep_and_widens_buffer():
+    _, _, _, _, samples = setup_model(n_samples=80)
+    inner = GraphLoader(samples, 4, shuffle=False, buckets=3)
+    pf = PrefetchLoader(inner, depth=2, device_put=False)
+    pf.set_group(2)
+    pf.set_superstep(4)
+    assert inner.block == 4 and inner.group == 2
+    assert pf._effective_depth() >= 4 * 2 + 1  # holds a full block ahead
+    # iteration yields the bucket-major order and survives the wider buffer
+    batches = list(pf)
+    assert len(batches) == len(inner)
+
+
+def test_double_buffer_preserves_order_and_propagates_errors():
+    from hydragnn_tpu.train.superstep import double_buffer
+
+    assert list(double_buffer(iter(range(20)))) == list(range(20))
+
+    def boom():
+        yield 1
+        raise RuntimeError("staging failed")
+
+    it = double_buffer(boom())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="staging failed"):
+        list(it)
+
+
+def test_make_superstep_k1_is_identity():
+    def fake(state, batch):
+        return state, {}
+
+    assert make_superstep(fake, 1) is fake
