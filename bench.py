@@ -38,6 +38,7 @@ import glob
 import json
 import os
 import re
+import statistics
 import sys
 import threading
 import time
@@ -586,6 +587,132 @@ def bench_superstep_ab(batch_size: int, bench_steps: int, warmup: int,
     }
 
 
+def bench_resilience_overhead(batch_size: int = 64, bench_steps: int = 30,
+                              warmup: int = 3, windows: int = 8) -> dict:
+    """Non-finite guard A/B (ISSUE 5): the same train step raw vs wrapped in
+    ``resilience.wrap_step_with_guard``. The guard fuses one finiteness
+    reduction + a single ``lax.cond`` skip into the step program — the
+    acceptance budget is <2% step-time overhead on the CPU smoke
+    (``within_budget`` records the check; the paired tier-1 test enforces
+    the mechanism, this row tracks the measured cost across rounds).
+
+    Methodology: a single long window per arm is hopeless on a loaded
+    2-vCPU CI host — cgroup CPU-quota stalls swing identical windows by
+    ±40ms/step, orders of magnitude above the effect being measured. The
+    two arms run in ``windows`` interleaved ABBA windows (one untimed
+    burn-in pair first: the first windows after an XLA compile run slow
+    while allocator/cache state settles, and that drift lands entirely on
+    whichever arm compiled last); the estimate is the median of PAIRED
+    per-window differences. ``noise_pct`` — the host's own resolution
+    limit — is the WORST of the pair-difference IQR and each arm's own
+    window IQR: repeated runs on a throttled host show the pair spread
+    alone underestimates run-to-run noise (pairs can agree with each other
+    while both arms drift), and a gate that trusts it issues hard verdicts
+    from scheduler luck. ``pass``/``fail`` are only issued when the
+    measurement resolves the budget: pass when overhead + noise is under
+    it, fail when overhead - noise is over it, a sharp threshold when the
+    noise floor is well under the budget — otherwise ``inconclusive``
+    records the numbers without laundering noise into a verdict. On a
+    quiet host noise_pct lands well under 2% and this is a sharp budget
+    assertion."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.resilience import wrap_step_with_guard
+    from hydragnn_tpu.train import (
+        create_train_state,
+        make_train_step,
+        select_optimizer,
+    )
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 64
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    samples = make_qm9_like_samples(max(batch_size * 2, 256), seed=31)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    batches = [jax.tree.map(jnp.asarray, b)
+               for b in GraphLoader(samples, batch_size, shuffle=True)]
+    step = make_train_step(model, optimizer)
+    guarded = wrap_step_with_guard(step)
+    # separate states so both arms advance comparably; donation retires the
+    # old buffers either way
+    state_raw = create_train_state(model, optimizer, batches[0])
+    state_grd = create_train_state(model, optimizer, batches[0])
+
+    state_raw, _ = _time_steps(step, state_raw, batches, warmup)     # compile
+    state_grd, _ = _time_steps(guarded, state_grd, batches, warmup)  # compile
+    # windows shorter than ~8 steps are dominated by scheduler jitter on the
+    # CI hosts — the per-window floor matters more than honoring bench_steps
+    n = max(bench_steps // max(windows, 1), 8)
+    # untimed burn-in pair: post-compile settle (allocator, caches, CPU
+    # frequency) otherwise biases the early windows of the last-compiled arm
+    state_raw, _ = _time_steps(step, state_raw, batches, n)
+    state_grd, _ = _time_steps(guarded, state_grd, batches, n)
+    raw_ms, grd_ms = [], []
+    for w in range(max(windows, 1)):
+        # ABBA order: alternate which arm runs first so a monotonic drift in
+        # host speed (thermal, co-tenant load) cancels instead of biasing
+        # whichever arm consistently ran second
+        if w % 2 == 0:
+            state_raw, t_raw = _time_steps(step, state_raw, batches, n)
+            state_grd, t_guard = _time_steps(guarded, state_grd, batches, n)
+        else:
+            state_grd, t_guard = _time_steps(guarded, state_grd, batches, n)
+            state_raw, t_raw = _time_steps(step, state_raw, batches, n)
+        raw_ms.append(1e3 * t_raw / n)
+        grd_ms.append(1e3 * t_guard / n)
+    med_raw = statistics.median(raw_ms)
+    diffs = [g - r for g, r in zip(grd_ms, raw_ms)]
+    overhead_pct = 100.0 * statistics.median(diffs) / med_raw
+
+    def _iqr(xs):
+        s = sorted(xs)
+        if len(s) < 4:  # too few windows for quartiles: full range (>= 0)
+            return s[-1] - s[0]
+        q = len(s) // 4
+        return s[-1 - q] - s[q]
+
+    # noise floor: the pair-difference spread AND each arm's own window
+    # spread — pairs can agree with each other while both arms drift, so
+    # trusting the pair IQR alone issues hard verdicts from scheduler luck
+    noise_pct = 100.0 * max(_iqr(diffs), _iqr(raw_ms), _iqr(grd_ms)) / med_raw
+    budget_pct = 2.0
+    if overhead_pct + noise_pct < budget_pct:
+        verdict = "pass"  # under budget even pessimistically
+    elif overhead_pct - noise_pct > budget_pct:
+        verdict = "fail"  # over budget even optimistically
+    elif noise_pct <= budget_pct / 2:
+        # the floor is well under the budget: the threshold itself resolves
+        verdict = "pass" if overhead_pct < budget_pct else "fail"
+    else:
+        verdict = "inconclusive"  # host too noisy to resolve the budget
+    if len(diffs) < 4 and noise_pct > budget_pct / 2:
+        # under 4 pairs the range-based floor underestimates the true
+        # spread — a stall hitting both windows of one arm can fabricate a
+        # confident verdict; only a near-zero floor earns one
+        verdict = "inconclusive"
+    return {
+        "workload": "resilience_overhead",
+        "step_ms_raw": round(med_raw, 3),
+        "step_ms_guarded": round(statistics.median(grd_ms), 3),
+        "step_ms_raw_windows": [round(x, 2) for x in raw_ms],
+        "step_ms_guarded_windows": [round(x, 2) for x in grd_ms],
+        "guard_overhead_pct": round(overhead_pct, 2),
+        "noise_pct": round(noise_pct, 2),
+        "budget_pct": budget_pct,
+        "verdict": verdict,
+        "within_budget": verdict != "fail",
+        "batch_size": batch_size,
+        "steps_timed": n * max(windows, 1),
+    }
+
+
 def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
                     k: int = 4) -> dict:
     """Degraded host-only row for dead-accelerator windows (the r3-r5
@@ -595,6 +722,7 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
     trajectory still carries signal without TPU hardware."""
     gin = bench_gin(batch_size, steps, warmup)
     ab = bench_superstep_ab(batch_size, max(steps, k), warmup, k=k)
+    guard = bench_resilience_overhead(batch_size, max(steps, 10), warmup)
     return {
         "workload": "cpu_smoke",
         "degraded": True,
@@ -603,6 +731,7 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
         "step_ms": gin["step_ms"],
         "collate_ms_per_batch": gin["collate_ms_per_batch"],
         "superstep_ab": ab,
+        "resilience_overhead": guard,
     }
 
 
@@ -1102,6 +1231,9 @@ def child_main(status_path: str) -> None:
         # same model/shape family (ISSUE 4 acceptance row)
         ("superstep_ab",
          lambda: bench_superstep_ab(batch_size, bench_steps, warmup)),
+        # guard cost rides the same family (ISSUE 5 acceptance row: <2%)
+        ("resilience_overhead",
+         lambda: bench_resilience_overhead(batch_size, bench_steps, warmup)),
         ("mlip", lambda: bench_mlip(min(batch_size, 64), bench_steps, warmup)),
         ("gps", lambda: bench_gps(min(batch_size, 128), bench_steps, warmup)),
         # after gps: keeps row continuity with earlier rounds if budget runs out

@@ -232,6 +232,22 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     # K train steps per device dispatch (train/superstep.py); env override
     # HYDRAGNN_SUPERSTEP wins at loop time
     training.setdefault("steps_per_dispatch", 1)
+    # fault tolerance (hydragnn_tpu.resilience): non-finite step guard with
+    # rollback escalation, preemption checkpointing, hung-dispatch watchdog
+    res_cfg = training.setdefault("resilience", {})
+    if not isinstance(res_cfg, dict):
+        raise ValueError(
+            f"Training.resilience must be a dict, got {type(res_cfg).__name__}"
+        )
+    # "auto" = guard reduced-precision training (bf16/fp16, where non-finite
+    # steps are routine) and leave fp32 opt-in: the guard's finiteness
+    # probe + pytree select adds an extra XLA compile of the step program,
+    # which fp32 runs that practically never diverge shouldn't pay for
+    res_cfg.setdefault("nonfinite_guard", "auto")
+    from ..resilience import config_defaults
+
+    for key, val in config_defaults().items():
+        res_cfg.setdefault(key, val)
     training.setdefault("loss_function_type", "mse")
     training.setdefault("precision", "fp32")
     training.setdefault("batch_size", 32)

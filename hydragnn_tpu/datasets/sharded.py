@@ -470,28 +470,64 @@ class ShardedStore:
         """One request/response round-trip on a pooled socket — no shared
         lock held, so concurrent callers overlap their network waits. The
         socket returns to the pool only after a clean round-trip; any error
-        closes it (a half-read stream cannot be reused)."""
+        closes it (a half-read stream cannot be reused).
+
+        Transient-fault policy (the request is idempotent, so retrying is
+        always safe): a stale POOLED socket (dropped by the peer/NAT while
+        parked) retries immediately on a fresh connection without counting
+        an attempt; a FRESH-connection failure — connect refused/reset/
+        timed out mid-stream — retries up to ``HYDRAGNN_STORE_RETRIES``
+        total attempts with exponential backoff + jitter, warning per retry,
+        so a blip in the fabric degrades to a logged pause instead of
+        killing the epoch. The last failure re-raises."""
+        import random
+        import warnings
+
+        from ..utils import flags
+
         if self._auth_token is not None:
             fields["token"] = np.frombuffer(self._auth_token.encode(), np.uint8)
         req = _pack_arrays(fields)
+        attempts = max(1, int(flags.get(flags.STORE_RETRIES)))
+        attempt = 0
+        delay = 0.05
         while True:
-            sock, from_pool = self._pool.acquire(rank, host, port)
             try:
-                _send_msg(sock, req)
-                payload = _recv_msg(sock)
-            except BaseException as e:
+                sock, from_pool = self._pool.acquire(rank, host, port)
+            except (ConnectionError, OSError) as e:
+                sock, from_pool, err = None, False, e
+            else:
+                err = None
                 try:
-                    sock.close()
-                except OSError:
-                    pass
-                # a socket parked idle in the pool can be dropped by the
-                # peer/NAT at any time; the request is idempotent, so retry
-                # it ONCE on a fresh connection before giving up
-                if from_pool and isinstance(e, (ConnectionError, OSError)):
-                    continue
-                raise
-            self._pool.release(rank, sock)
-            return payload
+                    _send_msg(sock, req)
+                    payload = _recv_msg(sock)
+                except BaseException as e:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    # a socket parked idle in the pool can be dropped by the
+                    # peer/NAT at any time; retry immediately on a fresh
+                    # connection without consuming an attempt
+                    if from_pool and isinstance(e, (ConnectionError, OSError)):
+                        continue
+                    if not isinstance(e, (ConnectionError, OSError)):
+                        raise
+                    err = e
+                else:
+                    self._pool.release(rank, sock)
+                    return payload
+            attempt += 1
+            if attempt >= attempts:
+                raise err
+            sleep_s = delay * (2 ** (attempt - 1)) * (1.0 + random.random())
+            warnings.warn(
+                f"shard fetch from {host}:{port} failed "
+                f"({type(err).__name__}: {err}); retry {attempt}/"
+                f"{attempts - 1} in {sleep_s:.2f}s "
+                "(HYDRAGNN_STORE_RETRIES tunes the cap)"
+            )
+            time.sleep(sleep_s)
 
     @staticmethod
     def _check_status(z: dict[str, np.ndarray], host: str, port: int,

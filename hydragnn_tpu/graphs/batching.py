@@ -394,6 +394,7 @@ class GraphLoader:
         self.epoch = 0
         self.group = max(1, int(group))
         self.block = 1
+        self._resume_skip = 0
 
     def set_group(self, n: int) -> None:
         """Multi-device stacking contract: the epoch loop stacks ``n``
@@ -483,6 +484,15 @@ class GraphLoader:
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)
 
+    def set_resume_point(self, raw_batches: int) -> None:
+        """Exact mid-epoch resume (``hydragnn_tpu.resilience``): the NEXT
+        epoch iteration omits the first ``raw_batches`` batches of the plan —
+        in FINAL plan order, i.e. after the bucket-major/group reorder, so a
+        run killed after n dispatches resumes on exactly the not-yet-seen
+        batches of the same deterministic (seed, epoch) permutation. One-shot:
+        consumed by the next ``batch_plan()``; later epochs iterate in full."""
+        self._resume_skip = max(0, int(raw_batches))
+
     def _full_permutation(self) -> np.ndarray:
         """The epoch permutation shared by all ranks, padded (by wrapping) to
         a multiple of ``world``. Identical on every rank — both the per-rank
@@ -547,6 +557,11 @@ class GraphLoader:
                     plan[j] = (plan[j][0], pad)
         if self.block > 1 and self.buckets and len(plan) > 1:
             plan = self._bucket_major(plan)
+        if self._resume_skip:
+            # mid-epoch resume: drop the already-trained prefix (post-reorder
+            # order — what the interrupted run actually consumed), one-shot
+            plan = plan[self._resume_skip:]
+            self._resume_skip = 0
         return plan
 
     def _bucket_major(self, plan):
@@ -677,12 +692,32 @@ class PrefetchLoader:
         self.samples = getattr(loader, "samples", [])
         self.pad = getattr(loader, "pad", None)
 
+    @property
+    def seed(self):
+        """The wrapped loader's shuffle seed — live, not a snapshot: the
+        preemption sidecar records it (loop._preempt_meta) and the resume
+        path checks it against the restored value to decide whether an exact
+        mid-epoch resume is permutation-safe."""
+        return getattr(self.loader, "seed", 0)
+
     def set_epoch(self, epoch: int) -> None:
         self.loader.set_epoch(epoch)
 
     def set_group(self, n: int) -> None:
         if hasattr(self.loader, "set_group"):
             self.loader.set_group(n)
+
+    def set_resume_point(self, raw_batches: int) -> None:
+        # no silent drop: claiming the capability while discarding the skip
+        # would double-train the resumed prefix under a claimed exact
+        # resume — an incapable inner loader must surface as AttributeError
+        # so the loop takes its restart-the-epoch fallback
+        if not hasattr(self.loader, "set_resume_point"):
+            raise AttributeError(
+                f"wrapped loader {type(self.loader).__name__} has no "
+                "set_resume_point — exact mid-epoch resume unsupported"
+            )
+        self.loader.set_resume_point(raw_batches)
 
     def set_superstep(self, k: int) -> None:
         """Block-granularity prefetch: delegate the bucket-major plan reorder

@@ -18,6 +18,15 @@ long-context mechanism.
 Config: ``NeuralNetwork.Architecture.edge_sharding: true`` routes
 ``run_training`` through these steps when more than one device is present.
 
+Resilience pass-through: the train step built here keeps the generic
+``(state, batch) -> (state, metrics)`` contract, so the non-finite step
+guard (``resilience/guard.py``) wraps it unchanged in the epoch loop —
+a NaN on ANY edge shard propagates into the all-reduced loss and the
+whole-mesh update is select-skipped in the same dispatch. Divergence
+rollback and preemption checkpointing operate at the loop/checkpoint layer
+and need nothing mode-specific; only supersteps stay pinned at K=1 (the
+per-batch ``put_large_batch`` placement has no stacked [K, ...] form yet).
+
 The Pallas fused-scatter kernel is trace-time disabled on this path (a
 pallas_call is opaque to the SPMD partitioner and would force an edge
 all-gather); the XLA segment_sum partitions cleanly.

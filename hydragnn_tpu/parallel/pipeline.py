@@ -42,6 +42,15 @@ selectable via ``norm``:
   rather than init values.
 * ``"running"``: eval-mode running averages — bit-exact parity with the
   sequential ``encode(train=False)`` path (what the exact-parity tests pin).
+
+Resilience pass-through: the pipelined train step keeps the generic
+``(state, batch) -> (state, metrics)`` contract, so the non-finite step
+guard (``resilience/guard.py``) wraps it unchanged in the epoch loop — a
+NaN in any microbatch reaches the accumulated loss and the stage-replicated
+update is select-skipped in the same dispatch. Divergence rollback and
+preemption checkpointing live at the loop/checkpoint layer and need nothing
+stage-aware; only supersteps stay pinned at K=1 (``put_microbatches`` is a
+per-step placement with no stacked [K, ...] form yet).
 """
 
 from __future__ import annotations

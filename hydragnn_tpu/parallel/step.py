@@ -18,6 +18,14 @@ program where
 
 The same step function runs unchanged on 1 device or a v5p pod — only the
 mesh and shardings differ.
+
+Resilience contract: every step factory here returns the generic
+``(state, batch) -> (state, metrics)`` shape with a scalar global
+``metrics["loss"]``, which is exactly what the non-finite step guard
+(``resilience/guard.py``) wraps — a NaN on any device shard reaches the
+graph-count-weighted global loss through the in-program all-reduce, so ONE
+poisoned shard skips the whole mesh's update in the same dispatch (no
+device ever applies a half-poisoned gradient).
 """
 
 from __future__ import annotations

@@ -1,0 +1,210 @@
+"""Fault-tolerant training: the resilience layer.
+
+HydraGNN's reference deployments are multi-day MLIP trainings on DOE
+schedulers where preemption, node loss, and diverging reduced-precision runs
+are routine — the reference ships a SLURM walltime guard and per-epoch
+best-checkpoint logic (both already ported: ``utils/walltime.py``,
+``train/checkpoint.py``). This package adds the rest of the story, threaded
+through the train loop, superstep, checkpoint, and data layers:
+
+* **Non-finite step guard** (``guard.py``): inside the jitted step, a NaN/Inf
+  loss (or exploded parameters from an Inf gradient) skips the optimizer
+  update via one ``lax.cond`` that forwards either the new or the incoming
+  state — the same skip-don't-branch discipline as the superstep's
+  fill-batch select, with zero extra dispatches and zero retraces. Default policy (``nonfinite_guard: "auto"``): armed
+  for reduced-precision training (bf16/fp16-class), where non-finite steps
+  are routine; fp32/fp64 opt in via config or ``HYDRAGNN_NONFINITE_GUARD=1``
+  (the guard costs one extra XLA compile of the step program). The host reads a ``skipped`` counter from the metrics
+  *after* dispatch (deferred by the in-flight window, so the async pipeline
+  keeps running ahead) and escalates: N consecutive skips → roll back to the
+  last good checkpoint with an LR cut; M rollbacks → abort with a diagnosis
+  (``TrainingDivergedError``).
+* **Preemption-safe checkpointing** (``preempt.py`` + ``train/checkpoint.py``):
+  SIGTERM/SIGUSR1 requests a checkpoint at the next dispatch boundary;
+  checkpoints are written atomically (temp + ``os.replace``) with a JSON
+  manifest (pytree structure hash + per-leaf checksums) and ``load_checkpoint``
+  falls back to the previous epoch when "latest" is dangling or corrupt.
+* **Exact mid-epoch resume**: the preemption checkpoint's sidecar records the
+  loader position (epoch, raw batches consumed, shuffle seed, superstep K,
+  device-group width); a resumed run consumes exactly the not-yet-seen
+  batches, so kill-at-step-k + resume bit-matches an uninterrupted fp32 run.
+* **Fault injection** (``chaos.py``, ``HYDRAGNN_FAULT_PLAN``): deterministic
+  NaN batches, mid-epoch SIGTERM, hung dispatches (watched by ``watchdog.py``
+  timers around the device syncs), and checkpoint corruption — so
+  ``tests/test_resilience.py`` proves every recovery path end-to-end instead
+  of trusting it.
+
+Mode coverage: the guard wraps any ``(state, batch) -> (state, metrics)``
+step, so data-parallel, FSDP, edge-sharded, and pipeline steps all pass
+through it unchanged (edge-sharded/pipeline keep their K=1 pin; the guard
+composes with K>1 supersteps by wrapping the step *before* the scan fold).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from contextlib import nullcontext
+
+from .chaos import FaultPlan
+from .guard import (
+    DivergenceDetected,
+    SkipTracker,
+    TrainingDivergedError,
+    wrap_step_with_guard,
+)
+from .preempt import PreemptionHandler
+from .watchdog import Watchdog
+
+
+@dataclasses.dataclass
+class Resilience:
+    """Per-run resilience context: configuration + the live fault machinery,
+    built once (``from_config``) and threaded through ``train_validate_test``
+    and ``train_epoch``. Also the back-channel the loop uses to report
+    preemption progress to ``run_training`` (which must then *not* overwrite
+    the mid-epoch "latest" pointer with a final save)."""
+
+    guard_enabled: bool = True
+    max_consecutive_skips: int = 25
+    max_rollbacks: int = 2
+    rollback_lr_factor: float = 0.5
+    checkpoint_on_preempt: bool = True
+    checkpoint_every_epoch: bool = False
+    watchdog_timeout: float = 0.0
+
+    preempt: PreemptionHandler | None = None
+    chaos: FaultPlan | None = None
+    watchdog: Watchdog | None = None
+    tracker: SkipTracker | None = None  # persistent skip-streak state
+
+    # the Training.resilience config keys whose defaults ARE these dataclass
+    # field defaults — the single source config.update_config and
+    # from_config both read, so a tuned default can't silently diverge
+    # between config-routed runs and direct train_validate_test callers
+    CONFIG_KEYS = (
+        "max_consecutive_skips",
+        "max_rollbacks",
+        "rollback_lr_factor",
+        "checkpoint_on_preempt",
+        "checkpoint_every_epoch",
+        "watchdog_timeout",
+    )
+
+    # live state, written by the loop / train_epoch
+    current_epoch: int = 0
+    interrupted: bool = False  # last train_epoch stopped on a preempt request
+    epoch_raw_done: int = 0  # raw batches consumed by the last train_epoch
+    preempted: bool = False  # loop saved a mid-epoch checkpoint and stopped
+    skipped_total: int = 0  # guard-skipped steps, summed over the run
+    rollbacks: int = 0
+
+    @staticmethod
+    def from_config(training_cfg: dict) -> "Resilience":
+        """Build from the ``Training.resilience`` config block (defaults
+        filled by ``config.update_config``; absent keys get the same
+        defaults here so direct ``train_validate_test`` callers behave
+        identically). ``nonfinite_guard`` accepts ``True``/``False`` or
+        ``"auto"`` (the default): guard reduced-precision training, where
+        non-finite steps are routine, and leave fp32 — which practically
+        never produces them — opt-in, so fp32 runs don't pay the guard's
+        extra XLA compile of the step program. ``HYDRAGNN_NONFINITE_GUARD``
+        overrides the guard switch; ``HYDRAGNN_FAULT_PLAN`` arms the chaos
+        harness."""
+        import jax.numpy as jnp
+
+        from ..train.step import resolve_precision
+        from ..utils import flags
+
+        cfg = dict(training_cfg.get("resilience") or {})
+        guard = cfg.get("nonfinite_guard", "auto")
+        if guard == "auto" or guard is None:
+            precision = resolve_precision(training_cfg.get("precision", "fp32"))
+            guard = jnp.dtype(precision).itemsize < 4  # bf16/fp16-class only
+        guard = bool(guard)
+        env_guard = flags.get(flags.NONFINITE_GUARD)
+        if env_guard is not None:
+            guard = bool(env_guard)
+        d = config_defaults()  # dataclass field defaults, the single source
+        timeout = float(cfg.get("watchdog_timeout", d["watchdog_timeout"]) or 0.0)
+        res = Resilience(
+            guard_enabled=guard,
+            max_consecutive_skips=int(
+                cfg.get("max_consecutive_skips", d["max_consecutive_skips"])
+            ),
+            max_rollbacks=int(cfg.get("max_rollbacks", d["max_rollbacks"])),
+            rollback_lr_factor=float(
+                cfg.get("rollback_lr_factor", d["rollback_lr_factor"])
+            ),
+            checkpoint_on_preempt=bool(
+                cfg.get("checkpoint_on_preempt", d["checkpoint_on_preempt"])
+            ),
+            checkpoint_every_epoch=bool(
+                cfg.get("checkpoint_every_epoch", d["checkpoint_every_epoch"])
+            ),
+            watchdog_timeout=timeout,
+            chaos=FaultPlan.from_env(),
+            watchdog=Watchdog(timeout) if timeout > 0 else None,
+        )
+        if res.checkpoint_on_preempt:
+            res.preempt = PreemptionHandler()
+        return res
+
+    # -- loop hooks ----------------------------------------------------------
+    def install(self) -> None:
+        if self.preempt is not None:
+            self.preempt.install()
+
+    def uninstall(self) -> None:
+        if self.preempt is not None:
+            self.preempt.uninstall()
+
+    def preempt_requested(self) -> bool:
+        return self.preempt is not None and self.preempt.requested
+
+    def new_tracker(self, lag: int) -> SkipTracker | None:
+        """The run's skip-streak tracker, or None when the guard (or its
+        escalation) is off. ONE tracker persists across epochs: a divergence
+        skipping every step of short epochs (fewer dispatches than
+        ``max_consecutive_skips``) must still accumulate a streak and
+        escalate — a per-epoch tracker would reset the count each epoch and
+        never fire. ``lag`` must be the loop's in-flight window so the
+        deferred metric reads never block on an unfinished dispatch."""
+        if not self.guard_enabled or self.max_consecutive_skips <= 0:
+            return None
+        if self.tracker is None:
+            self.tracker = SkipTracker(self.max_consecutive_skips, lag=lag)
+        else:
+            self.tracker.lag = max(0, int(lag))
+        return self.tracker
+
+    def reset_streak(self) -> None:
+        """Forget the consecutive-skip streak (rollback restored a good
+        state; the retry starts clean). Run totals stay for diagnosis."""
+        if self.tracker is not None:
+            self.tracker.consecutive = 0
+
+    def watchdog_guard(self, what: str):
+        if self.watchdog is None:
+            return nullcontext()
+        return self.watchdog.guard(what)
+
+
+def config_defaults() -> dict:
+    """``{config key: default}`` for the ``Training.resilience`` block, read
+    off the ``Resilience`` dataclass fields — ``config.update_config`` fills
+    the block from this, so the two can't drift."""
+    fields = {f.name: f.default for f in dataclasses.fields(Resilience)}
+    return {k: fields[k] for k in Resilience.CONFIG_KEYS}
+
+
+__all__ = [
+    "DivergenceDetected",
+    "FaultPlan",
+    "PreemptionHandler",
+    "Resilience",
+    "SkipTracker",
+    "TrainingDivergedError",
+    "Watchdog",
+    "config_defaults",
+    "wrap_step_with_guard",
+]

@@ -1,0 +1,176 @@
+"""Fault-injection harness (``HYDRAGNN_FAULT_PLAN``): deterministic chaos.
+
+A recovery path that has never run is a recovery path that does not work.
+This module injects the faults the resilience layer claims to survive, at
+exact (epoch, dispatch) coordinates, so ``tests/test_resilience.py`` can
+prove each path end-to-end — and so an operator can rehearse a preemption
+drill on a real cluster with one env var instead of ssh-ing kill signals.
+
+Plan format — a JSON list of events (inline, or ``@/path/to/plan.json``)::
+
+    HYDRAGNN_FAULT_PLAN='[
+      {"fault": "nan_batch", "epoch": 0, "dispatch": 3},
+      {"fault": "sigterm",   "epoch": 1, "dispatch": 5},
+      {"fault": "hang",      "epoch": 0, "dispatch": 2, "seconds": 1.5},
+      {"fault": "corrupt_latest", "epoch": 0}
+    ]'
+
+* ``nan_batch`` — multiply the batch's node features by NaN *after* device
+  placement (an elementwise op, so shardings are preserved and nothing
+  retraces): the NaN flows through the real forward/loss/grad path exactly
+  like a genuine fp16/bf16 blow-up would.
+* ``sigterm`` — the process signals itself; the installed
+  ``PreemptionHandler`` turns it into a checkpoint-and-stop at the next
+  dispatch boundary (a faithful SLURM preemption rehearsal).
+* ``hang`` — sleep ``seconds`` inside the watchdog-guarded dispatch region,
+  proving the hung-dispatch timer fires.
+* ``corrupt_latest`` — at the end of the matching epoch, truncate the
+  largest leaf file of the checkpoint "latest" points to, so the next
+  restore must take the manifest-verified fallback path.
+
+``dispatch`` omitted/null matches every dispatch of the epoch; ``times``
+caps how often an event fires (default 1; -1 = unlimited).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from pathlib import Path
+
+_FAULTS = ("nan_batch", "sigterm", "hang", "corrupt_latest")
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    fault: str
+    epoch: int = 0
+    dispatch: int | None = None  # None = every dispatch of the epoch
+    seconds: float = 1.0  # hang only
+    times: int = 1  # -1 = unlimited
+
+    def matches(self, epoch: int, dispatch: int | None) -> bool:
+        if self.times == 0 or self.epoch != epoch:
+            return False
+        return self.dispatch is None or self.dispatch == dispatch
+
+    def consume(self) -> None:
+        if self.times > 0:
+            self.times -= 1
+
+
+class FaultPlan:
+    """Ordered fault events + a fired-event log (what/where, for tests and
+    post-mortems)."""
+
+    def __init__(self, events):
+        self.events = list(events)
+        self.log: list[tuple[str, int, int | None]] = []
+
+    @staticmethod
+    def parse(text: str) -> "FaultPlan":
+        if text.startswith("@"):
+            with open(text[1:]) as f:
+                raw = json.load(f)
+        else:
+            raw = json.loads(text)
+        if isinstance(raw, dict):
+            raw = [raw]
+        events = []
+        for i, e in enumerate(raw):
+            fault = e.get("fault")
+            if fault not in _FAULTS:
+                raise ValueError(
+                    f"HYDRAGNN_FAULT_PLAN event {i}: fault {fault!r} not one "
+                    f"of {_FAULTS}"
+                )
+            events.append(
+                FaultEvent(
+                    fault=fault,
+                    epoch=int(e.get("epoch", 0)),
+                    dispatch=(
+                        None if e.get("dispatch") is None else int(e["dispatch"])
+                    ),
+                    seconds=float(e.get("seconds", 1.0)),
+                    times=int(e.get("times", 1)),
+                )
+            )
+        return FaultPlan(events)
+
+    @staticmethod
+    def from_env() -> "FaultPlan | None":
+        from ..utils import flags
+
+        text = flags.get(flags.FAULT_PLAN)
+        if not text:
+            return None
+        return FaultPlan.parse(str(text))
+
+    def _take(self, fault: str, epoch: int, dispatch: int | None):
+        for ev in self.events:
+            if ev.fault == fault and ev.matches(epoch, dispatch):
+                ev.consume()
+                self.log.append((fault, epoch, dispatch))
+                return ev
+        return None
+
+    # -- loop hooks ----------------------------------------------------------
+    def on_dispatch(self, epoch: int, dispatch: int, batch):
+        """Apply dispatch-scoped faults; returns the (possibly poisoned)
+        batch. Called inside the loop's watchdog-guarded dispatch region so
+        an injected hang exercises the real timer."""
+        ev = self._take("hang", epoch, dispatch)
+        if ev is not None:
+            time.sleep(ev.seconds)
+        if self._take("sigterm", epoch, dispatch) is not None:
+            os.kill(os.getpid(), signal.SIGTERM)
+        if self._take("nan_batch", epoch, dispatch) is not None:
+            batch = poison_batch(batch)
+        return batch
+
+    def on_epoch_end(self, epoch: int, log_name: str, path: str = "./logs/"):
+        """Apply epoch-scoped faults (checkpoint corruption) after the
+        epoch's checkpoints are written. Each matching event fires at most
+        ONCE per epoch end (``times: -1`` means "at every matching epoch",
+        not "loop forever re-corrupting within one epoch")."""
+        for ev in self.events:
+            if ev.fault != "corrupt_latest" or not ev.matches(epoch, None):
+                continue
+            ev.consume()
+            self.log.append(("corrupt_latest", epoch, None))
+            from ..train.checkpoint import _ckpt_dir
+
+            latest = os.path.join(_ckpt_dir(log_name, path), "latest")
+            target = os.path.realpath(latest)
+            if os.path.isdir(target):
+                corrupt_checkpoint(target)
+
+
+def poison_batch(batch):
+    """NaN the node features through an elementwise multiply — preserves
+    shape, dtype, AND sharding (no retrace under jit), and the NaN reaches
+    the loss through the genuine forward path."""
+    return batch.replace(x=batch.x * float("nan"))
+
+
+def corrupt_checkpoint(ckpt_path: str) -> str:
+    """Truncate the largest file under an orbax checkpoint dir to half its
+    size — the deterministic stand-in for a node dying mid-write or a
+    filesystem tearing a block. Returns the mangled file's path."""
+    files = sorted(
+        (p for p in Path(ckpt_path).rglob("*") if p.is_file()),
+        key=lambda p: (p.stat().st_size, str(p)),
+    )
+    if not files:
+        raise FileNotFoundError(f"no files to corrupt under {ckpt_path}")
+    target = files[-1]
+    size = target.stat().st_size
+    with open(target, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    return str(target)
+
+
+__all__ = ["FaultEvent", "FaultPlan", "corrupt_checkpoint", "poison_batch"]

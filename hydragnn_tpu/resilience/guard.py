@@ -1,0 +1,186 @@
+"""Non-finite step guard: skip NaN/Inf updates on device, escalate on host.
+
+A single NaN loss in a jitted train step poisons the whole device group: the
+gradient is NaN, AdamW writes NaN into every parameter and moment buffer, and
+every later step is garbage — a multi-day run dies silently at step k and
+trains noise for the remaining days (``models/common.py`` documents exactly
+this failure mode for masked BatchNorm fill batches). The reference's answer
+is a human watching the loss curve; ours is a guard INSIDE the step:
+
+* ``wrap_step_with_guard`` — wraps any jitted ``(state, batch) -> (state,
+  metrics)`` step. After the wrapped step runs, a finiteness check on the
+  loss AND the updated parameters/batch stats/optimizer state (an Inf
+  gradient can produce a finite loss but Inf params, and a merely-huge one
+  can overflow an Adam moment while params stay finite) gates ONE
+  ``lax.cond`` whose branches merely forward either the new or the incoming
+  state pytree — the same skip-don't-branch discipline as the superstep's
+  fill-batch ``jnp.where`` select, but with a single conditional instead of
+  one select thunk per state leaf (measurably cheaper on CPU, where per-op
+  dispatch dominates tiny CI steps; both stay inside one step program with
+  no extra dispatch and no retrace). A skipped step also zeroes its metric
+  weights (``num_graphs`` → 0), so the epoch's weighted aggregates ignore
+  it, and reverts ``step`` — the dropout rng fold retries the same stream
+  instead of drifting from the K=1 counter.
+* ``SkipTracker`` — host-side consecutive-skip escalation with DEFERRED
+  reads: the loop pushes each dispatch's on-device ``skipped`` scalar and the
+  tracker only materializes values older than the loop's in-flight window
+  (values the backpressure sync has already waited for), so tracking adds
+  zero pipeline stalls. Crossing the streak limit raises
+  ``DivergenceDetected``; the epoch loop answers with rollback-to-last-good
+  checkpoint + LR cut, and after ``max_rollbacks`` raises
+  ``TrainingDivergedError`` with a diagnosis.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DivergenceDetected(RuntimeError):
+    """Skip streak crossed ``max_consecutive_skips`` — the run is diverging.
+
+    Raised host-side (never inside jit); the epoch loop catches it and rolls
+    back to the last good checkpoint with an LR cut."""
+
+
+class TrainingDivergedError(RuntimeError):
+    """Terminal divergence: rollback-with-LR-cut was tried ``max_rollbacks``
+    times and the run still produces non-finite steps. Carries a diagnosis
+    (skip counts, rollback count, LR trajectory) instead of a NaN soup."""
+
+
+def _all_finite(tree) -> jax.Array:
+    """Scalar bool: every floating leaf of ``tree`` is finite.
+
+    One scalar probe instead of per-leaf ``all(isfinite(...))``: ``x * 0``
+    is 0 for finite x and NaN for NaN/±Inf, so ``sum(leaf * 0)`` is 0 iff
+    the leaf is clean and the sum of the per-leaf probes is 0 iff the tree
+    is. That is 2 cheap ops per leaf (multiply + reduce, fused by XLA) with
+    no full-size bool temporaries and no O(leaves) logical_and chain — the
+    guard's check must stay affordable on tiny CI models where per-op
+    dispatch overhead, not FLOPs, dominates the step."""
+    probe = jnp.float32(0)
+    for leaf in jax.tree.leaves(tree):
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            probe = probe + jnp.sum(leaf * 0).astype(jnp.float32)
+    return probe == 0
+
+
+def wrap_step_with_guard(train_step, donate_argnums=None):
+    """Wrap a jitted ``(state, batch) -> (state, metrics)`` train step so a
+    non-finite step is skipped on device (one ``lax.cond``).
+
+    Works for every step family (single-device, SPMD data mesh, FSDP, MLIP,
+    edge-sharded, pipeline) because it only assumes the ``(state, batch) ->
+    (state, metrics)`` contract with a scalar ``metrics["loss"]``. Compose
+    with supersteps by guarding the step BEFORE ``make_superstep`` folds it
+    into the scan — the skip then rides the existing fill-skip machinery and
+    the whole K-block stays one dispatch.
+
+    The returned metrics gain an int32 ``skipped`` flag (1 = this step was
+    dropped); on a skipped step every other metric is zeroed, so the
+    graph-count-weighted epoch aggregates in ``loop._accumulate`` ignore it
+    exactly like a fill batch.
+    """
+    from ..train.step import donate_state_argnums
+
+    donate = donate_state_argnums() if donate_argnums is None else donate_argnums
+
+    @functools.partial(jax.jit, donate_argnums=donate)
+    def guarded_step(state, batch):
+        new_state, metrics = train_step(state, batch)
+        # loss finiteness catches NaN forward/loss; param finiteness catches
+        # the finite-loss/Inf-grad case (the update itself exploded); opt
+        # state finiteness catches an overflowed optimizer moment (a huge
+        # grad can blow nu to Inf while the Adam update mu/sqrt(Inf) and the
+        # params stay finite — unguarded, that moment stays Inf forever and
+        # silently zeroes the parameter's updates for the rest of the run).
+        # All reduce to ONE scalar predicate fused into the step program.
+        ok = _all_finite((
+            metrics["loss"],
+            new_state.params,
+            new_state.batch_stats,
+            new_state.opt_state,
+        ))
+        # One lax.cond on the replicated scalar instead of a jnp.where per
+        # leaf: the branches only forward already-computed pytrees, so the
+        # skip costs a single conditional, not O(leaves) select thunks. The
+        # skipped branch returns the donated-in state (step counter included,
+        # so the dropout rng fold retries the same stream) and zeroed
+        # metrics, which the weighted epoch aggregates ignore like a fill
+        # batch.
+        zeroed = jax.tree.map(jnp.zeros_like, metrics)
+        new_state, metrics = jax.lax.cond(
+            ok,
+            lambda new, m, old, z: (new, m),
+            lambda new, m, old, z: (old, z),
+            new_state, metrics, state, zeroed,
+        )
+        metrics["skipped"] = jnp.logical_not(ok).astype(jnp.int32)
+        return new_state, metrics
+
+    return guarded_step
+
+
+class SkipTracker:
+    """Consecutive-skip escalation over a stream of on-device ``skipped``
+    metrics, reading each value only after the loop's backpressure window
+    guarantees its dispatch completed (so tracking never stalls the async
+    pipeline). Accepts scalars (per-step dispatch) and ``[K]`` vectors
+    (superstep blocks). The streak deliberately survives ``finish()`` so one
+    tracker can span epochs (see ``Resilience.new_tracker``): an epoch
+    boundary is not evidence the run recovered."""
+
+    def __init__(self, max_consecutive: int, lag: int = 32):
+        self.max_consecutive = int(max_consecutive)
+        self.lag = max(0, int(lag))
+        self.consecutive = 0
+        self.total = 0
+        self.steps = 0
+        self._pending: deque = deque()
+
+    def push(self, skipped) -> None:
+        """Queue one dispatch's ``skipped`` metric; drains (and may raise
+        ``DivergenceDetected``) once the value is older than the lag
+        window."""
+        self._pending.append(skipped)
+        while len(self._pending) > self.lag:
+            self._drain_one()
+
+    def finish(self) -> None:
+        """Drain everything (epoch end — the loop has already blocked on the
+        last dispatch)."""
+        while self._pending:
+            self._drain_one()
+
+    def _drain_one(self) -> None:
+        arr = np.atleast_1d(
+            np.asarray(jax.device_get(self._pending.popleft()), np.int64)
+        )
+        for s in arr:
+            self.steps += 1
+            if s:
+                self.total += 1
+                self.consecutive += 1
+            else:
+                self.consecutive = 0
+        if 0 < self.max_consecutive <= self.consecutive:
+            self._pending.clear()
+            raise DivergenceDetected(
+                f"{self.consecutive} consecutive non-finite training steps "
+                f"were skipped ({self.total} of {self.steps} steps skipped "
+                "so far this run) — the run is diverging"
+            )
+
+
+__all__ = [
+    "DivergenceDetected",
+    "SkipTracker",
+    "TrainingDivergedError",
+    "wrap_step_with_guard",
+]

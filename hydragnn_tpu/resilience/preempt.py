@@ -1,0 +1,68 @@
+"""Preemption handler: turn SIGTERM/SIGUSR1 into a checkpoint request.
+
+SLURM preemption sends SIGTERM (or the user-requested ``--signal=USR1@k``)
+ahead of the hard kill; torque/LSF/k8s evictions look the same. The walltime
+guard (``utils/walltime.py``) covers the *predictable* end of a job; this
+handler covers the unpredictable one. The handler itself only sets a flag —
+signal context is no place for device syncs or file IO — and the epoch loop
+polls it at dispatch boundaries, saves a mid-epoch checkpoint (with the
+loader position in the sidecar, see ``train/checkpoint.py``), and stops
+cleanly, so at most one dispatch of work is lost.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+
+class PreemptionHandler:
+    """Install with :meth:`install`, poll :attr:`requested`, and always
+    :meth:`uninstall` (restores the previous handlers) when the loop exits —
+    the loop does this in a ``finally`` so an abort can't leave the process
+    ignoring real SIGTERMs."""
+
+    SIGNALS = ("SIGTERM", "SIGUSR1")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._prev: dict[int, object] = {}
+        self._installed = False
+
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        for name in self.SIGNALS:
+            signum = getattr(signal, name, None)
+            if signum is None:
+                continue
+            try:
+                self._prev[signum] = signal.signal(signum, self._on_signal)
+            except (ValueError, OSError):
+                # not the main thread (or an embedded interpreter): polling
+                # still works if someone else sets the event; just skip
+                continue
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        for signum, prev in self._prev.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def _on_signal(self, signum, frame) -> None:  # signal context: flag only
+        self._event.set()
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def clear(self) -> None:
+        self._event.clear()
+
+
+__all__ = ["PreemptionHandler"]

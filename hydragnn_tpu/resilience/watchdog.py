@@ -1,0 +1,98 @@
+"""Hung-dispatch watchdog: a timer around host-blocking device syncs.
+
+A wedged interconnect or a deadlocked collective doesn't crash a JAX run —
+it parks the host forever inside ``block_until_ready`` with zero log output,
+which on a scheduler means burning the full walltime allocation in silence.
+The watchdog arms a deadline around each blocking sync (the loop's
+backpressure wait and the end-of-epoch drain); if the sync outlives the
+timeout a warning (and an optional callback) fires from a monitor thread,
+so the operator/log gets a "dispatch N has been stuck for T seconds"
+breadcrumb while the main thread is still blocked. It deliberately does NOT
+try to kill the sync — interrupting XLA mid-collective corrupts the runtime;
+detection + diagnosis is the job, the scheduler owns the kill.
+
+ONE long-lived daemon monitor thread serves every guarded region (lazily
+started, parked on a condition variable while nothing is armed): the loop
+enters a guard 2+ times per dispatch, and spawning/cancelling a fresh
+``threading.Timer`` thread each time would put hundreds of OS thread
+creations per second on exactly the dispatch-latency-bound path the
+superstep work exists to shrink.
+
+The chaos harness (``chaos.py`` ``hang`` events) injects a deterministic
+sleep inside a guarded region to prove the timer actually fires.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from contextlib import contextmanager
+
+
+class Watchdog:
+    """``with watchdog.guard("step sync"): jax.block_until_ready(...)`` —
+    fires ``on_hang(what)`` (and a warning) if the region runs longer than
+    ``timeout_s``. A zero/negative timeout disables the guard entirely
+    (zero overhead: the context manager short-circuits)."""
+
+    def __init__(self, timeout_s: float, on_hang=None):
+        self.timeout_s = float(timeout_s)
+        self.on_hang = on_hang
+        self.fired = 0
+        self.events: list[str] = []
+        self._cond = threading.Condition()
+        self._deadline: tuple[float, str] | None = None  # guarded by _cond
+        self._thread: threading.Thread | None = None
+
+    @contextmanager
+    def guard(self, what: str = "device sync"):
+        if self.timeout_s <= 0:
+            yield
+            return
+        with self._cond:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._monitor, name="hydragnn-watchdog", daemon=True
+                )
+                self._thread.start()
+            self._deadline = (time.monotonic() + self.timeout_s, what)
+            self._cond.notify()
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._deadline = None
+                self._cond.notify()
+
+    def _monitor(self) -> None:  # daemon thread: dies with the process
+        while True:
+            with self._cond:
+                if self._deadline is None:
+                    self._cond.wait()  # parked: nothing armed, zero cost
+                    continue
+                t, what = self._deadline
+                remaining = t - time.monotonic()
+                if remaining > 0:
+                    self._cond.wait(remaining)
+                    continue
+                # deadline passed with the region still armed: fire ONCE
+                # (clearing the deadline keeps a still-hung region from
+                # re-firing every wakeup; the next guard re-arms)
+                self._deadline = None
+                self.fired += 1
+                self.events.append(what)
+            warnings.warn(
+                f"watchdog: {what} exceeded {self.timeout_s:.1f}s — a "
+                "dispatch appears hung (wedged interconnect / deadlocked "
+                "collective?); the run continues but needs attention",
+                stacklevel=2,
+            )
+            if self.on_hang is not None:
+                try:
+                    self.on_hang(what)
+                except Exception:
+                    pass  # a broken callback must not kill the monitor
+
+
+__all__ = ["Watchdog"]

@@ -74,7 +74,11 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
 
     # resume (reference load_existing_model_config, model.py:202-216):
     # Training.continue truthy -> restore model+optimizer from the run named
-    # by Training.startfrom (default: this run's log name)
+    # by Training.startfrom (default: this run's log name). A preemption
+    # checkpoint's sidecar (mid_epoch) additionally carries the exact loader
+    # position; it flows into train_validate_test so the resumed run
+    # consumes precisely the not-yet-seen batches (hydragnn_tpu.resilience).
+    resume_meta = None
     if training_cfg.get("continue"):
         from .train.checkpoint import load_checkpoint
 
@@ -87,6 +91,13 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
         except FileNotFoundError as e:
             raise FileNotFoundError(
                 f"Training.continue set but no checkpoint under logs/{startfrom}: {e}"
+            )
+        if meta.get("mid_epoch"):
+            resume_meta = meta
+            print_distributed(
+                verbosity,
+                f"mid-epoch resume: epoch {meta.get('epoch')}, "
+                f"{meta.get('raw_batches_done')} batches already trained",
             )
 
     # auto-scale to every local device: one SPMD program over a 1D data mesh
@@ -256,6 +267,14 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             test_loader, depth=depth, device_put=dput_eval, workers=workers
         )
 
+    # fault-tolerance context (hydragnn_tpu.resilience): non-finite step
+    # guard + divergence rollback, preemption checkpointing, chaos harness.
+    # Built HERE (not inside the loop) so the preemption outcome is visible
+    # below: a preempted run must keep its mid-epoch "latest" pointer.
+    from .resilience import Resilience
+
+    resilience = Resilience.from_config(training_cfg)
+
     state = train_validate_test(
         model,
         optimizer,
@@ -269,23 +288,35 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
         writer=writer,
         walltime_check=make_walltime_check(),
         mesh=mesh,
+        resilience=resilience,
+        resume_meta=resume_meta,
     )
     if writer is not None:
         writer.close()
 
     # always save the final model (reference run_training.py:206 save_model);
-    # resumable via Training.continue + startfrom=<log_name>
-    try:
-        from .train.checkpoint import save_checkpoint
-
-        save_checkpoint(
-            state,
-            log_name,
-            epoch=int(config["NeuralNetwork"]["Training"].get("num_epoch", 0)),
-            meta={"final": True},
+    # resumable via Training.continue + startfrom=<log_name>. EXCEPT after a
+    # preemption: the mid-epoch checkpoint IS the resume point, and
+    # re-pointing "latest" at a final-save would discard the loader position
+    # its sidecar records.
+    if resilience.preempted:
+        print_distributed(
+            verbosity,
+            "preempted: mid-epoch checkpoint is the resume point; "
+            "skipping the final save",
         )
-    except Exception as e:  # a failed save must not kill a finished training
-        print_distributed(verbosity, f"final model save failed: {e}")
+    else:
+        try:
+            from .train.checkpoint import save_checkpoint
+
+            save_checkpoint(
+                state,
+                log_name,
+                epoch=int(config["NeuralNetwork"]["Training"].get("num_epoch", 0)),
+                meta={"final": True},
+            )
+        except Exception as e:  # a failed save must not kill a finished training
+            print_distributed(verbosity, f"final model save failed: {e}")
 
     # end-of-run visualization (reference train_validate_test :441-491)
     if config.get("Visualization", {}).get("create_plots"):

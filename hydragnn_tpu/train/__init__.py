@@ -6,7 +6,7 @@ from .step import (
     make_predict_step,
     resolve_precision,
 )
-from .superstep import make_superstep, double_buffer
+from .superstep import make_superstep, double_buffer, select_state
 from .optimizer import select_optimizer, ReduceLROnPlateau, get_learning_rate, set_learning_rate
 from .loop import train_validate_test, train_epoch, evaluate, test
 from .checkpoint import save_checkpoint, load_checkpoint, Checkpoint, EarlyStopping
@@ -20,6 +20,7 @@ __all__ = [
     "resolve_precision",
     "make_superstep",
     "double_buffer",
+    "select_state",
     "select_optimizer",
     "ReduceLROnPlateau",
     "get_learning_rate",

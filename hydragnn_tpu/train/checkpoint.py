@@ -2,17 +2,33 @@
 
 Reference semantics (``hydragnn/utils/model/model.py:104-311, 513-571``):
 best-model checkpointing on validation-loss improvement after a warmup epoch
-count, per-epoch files with a symlink to the latest, resume via
+count, per-epoch files with a "latest" pointer, resume via
 ``Training.continue``/``startfrom``, and patience-based EarlyStopping. Here a
 checkpoint is an orbax-saved pytree {params, batch_stats, opt_state, step} —
 sharded-array-aware, so the same path works under pjit — plus a small JSON
-sidecar with scheduler/epoch metadata.
+sidecar with scheduler/epoch/loader-position metadata.
+
+Crash-safety contract (the resilience layer, ``hydragnn_tpu/resilience``):
+
+* every host-visible mutation is atomic — the meta/manifest sidecars write
+  to a temp file and ``os.replace``, and the "latest" pointer swaps via
+  symlink-to-temp + ``os.replace`` (the old remove-then-``os.symlink`` had a
+  crash window that left NO pointer and stranded resume);
+* each checkpoint carries a manifest (pytree structure hash + per-leaf
+  crc32) so a torn write is *detected* at restore instead of silently
+  training on garbage;
+* ``load_checkpoint`` falls back epoch-by-epoch when "latest" dangles or the
+  target is corrupt, and raises a ``FileNotFoundError`` naming the run dir
+  only when nothing under it is loadable.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import warnings
+import zlib
 from typing import Any
 
 import jax
@@ -22,8 +38,105 @@ import orbax.checkpoint as ocp
 from .step import TrainState
 
 
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint restored but failed manifest verification (structure
+    hash or a per-leaf checksum mismatch) — a torn/partial write."""
+
+
 def _ckpt_dir(log_name: str, path: str = "./logs/") -> str:
     return os.path.abspath(os.path.join(path, log_name, "checkpoints"))
+
+
+def _write_json_atomic(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _atomic_symlink(target: str, link: str) -> None:
+    """Repoint ``link`` at ``target`` with no crash window: the new symlink
+    is born under a temp name and ``os.replace`` swaps it in atomically —
+    every observer sees either the old pointer or the new one, never a
+    missing/half-made one."""
+    tmp = f"{link}.tmp{os.getpid()}"
+    if os.path.islink(tmp) or os.path.exists(tmp):
+        os.remove(tmp)
+    os.symlink(target, tmp)
+    os.replace(tmp, link)
+
+
+def _leaf_arrays(state):
+    """(keypath string, leaf) pairs in flatten order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _structure_hash(state) -> str:
+    return hashlib.sha256(
+        str(jax.tree_util.tree_structure(state)).encode()
+    ).hexdigest()
+
+
+def _host_leaves(state):
+    """``(pairs, host)``: the (keypath, leaf) pairs plus ``{index:
+    contiguous host ndarray}`` for every fully-addressable leaf, fetched in
+    ONE batched ``jax.device_get`` — a per-leaf get would round-trip the
+    device once per leaf, and on a large model that turns every checkpoint
+    save/verify into hundreds of serial transfers."""
+    pairs = _leaf_arrays(state)
+    idx = [
+        i
+        for i, (_, leaf) in enumerate(pairs)
+        if getattr(leaf, "is_fully_addressable", True)
+    ]
+    fetched = jax.device_get([pairs[i][1] for i in idx])
+    return pairs, {
+        i: np.ascontiguousarray(a) for i, a in zip(idx, fetched)
+    }
+
+
+def _crc(arr: np.ndarray) -> int:
+    # the flattened view satisfies the buffer protocol directly — no
+    # tobytes() full copy of the leaf just to checksum it
+    return zlib.crc32(arr.reshape(-1)) & 0xFFFFFFFF
+
+
+def build_manifest(state) -> dict:
+    """Integrity manifest: pytree structure hash + per-leaf dtype/shape/crc32.
+    Per-leaf checksums are skipped for leaves this process cannot fully
+    address (multi-host sharded arrays — orbax owns their consistency); the
+    structure hash still guards the pytree."""
+    pairs, host = _host_leaves(state)
+    leaves = []
+    for i, (key, leaf) in enumerate(pairs):
+        entry: dict[str, Any] = {"path": key}
+        if hasattr(leaf, "shape"):
+            entry["shape"] = [int(d) for d in leaf.shape]
+        if i in host:
+            entry["dtype"] = str(host[i].dtype)
+            entry["crc32"] = _crc(host[i])
+        leaves.append(entry)
+    return {"treedef_sha256": _structure_hash(state), "leaves": leaves}
+
+
+def verify_manifest(state, manifest: dict, ckpt_path: str) -> None:
+    """Raise ``CheckpointCorruptError`` when the restored state disagrees
+    with the manifest written at save time."""
+    if manifest.get("treedef_sha256") != _structure_hash(state):
+        raise CheckpointCorruptError(
+            f"{ckpt_path}: pytree structure does not match its manifest"
+        )
+    by_path = {e["path"]: e for e in manifest.get("leaves", [])}
+    pairs, host = _host_leaves(state)
+    for i, (key, leaf) in enumerate(pairs):
+        entry = by_path.get(key)
+        if entry is None or "crc32" not in entry or i not in host:
+            continue
+        if _crc(host[i]) != entry["crc32"]:
+            raise CheckpointCorruptError(
+                f"{ckpt_path}: leaf {key} fails its checksum (torn write?)"
+            )
 
 
 def save_checkpoint(
@@ -34,39 +147,135 @@ def save_checkpoint(
     meta: dict | None = None,
 ) -> str:
     """Write epoch checkpoint and update the 'latest' pointer (the reference's
-    per-epoch files + symlink scheme, ``model.py:160-188``)."""
+    per-epoch files + pointer scheme, ``model.py:160-188``). Write order is
+    the recovery order: payload (orbax is internally write-temp-then-rename),
+    then manifest, then meta, then the pointer swap — a crash at ANY point
+    leaves the previous "latest" resumable."""
     base = _ckpt_dir(log_name, path)
     os.makedirs(base, exist_ok=True)
     ckpt_path = os.path.join(base, f"epoch_{epoch}")
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(ckpt_path, state, force=True)
-    with open(os.path.join(base, f"epoch_{epoch}.meta.json"), "w") as f:
-        json.dump({"epoch": epoch, **(meta or {})}, f)
-    latest = os.path.join(base, "latest")
-    if os.path.islink(latest) or os.path.exists(latest):
-        os.remove(latest)
-    os.symlink(ckpt_path, latest)
+    _write_json_atomic(ckpt_path + ".manifest.json", build_manifest(state))
+    _write_json_atomic(
+        os.path.join(base, f"epoch_{epoch}.meta.json"),
+        {"epoch": epoch, **(meta or {})},
+    )
+    _atomic_symlink(ckpt_path, os.path.join(base, "latest"))
     return ckpt_path
 
 
-def load_checkpoint(
-    template: TrainState, log_name: str, path: str = "./logs/", epoch: int | None = None
-) -> tuple[TrainState, dict]:
-    """Restore a checkpoint into the structure of ``template``."""
-    base = _ckpt_dir(log_name, path)
-    ckpt_path = (
-        os.path.join(base, f"epoch_{epoch}") if epoch is not None else os.path.join(base, "latest")
-    )
-    ckpt_path = os.path.realpath(ckpt_path)
+def _epoch_candidates(base: str) -> list[str]:
+    """Epoch checkpoint dirs under ``base``, newest epoch first."""
+    out = []
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return []
+    for name in names:
+        if not name.startswith("epoch_"):
+            continue
+        full = os.path.join(base, name)
+        if not os.path.isdir(full):
+            continue
+        try:
+            out.append((int(name[len("epoch_"):]), full))
+        except ValueError:
+            continue
+    return [full for _, full in sorted(out, reverse=True)]
+
+
+def _restore_one(ckpt_path: str, template: TrainState, verify: bool):
+    if not os.path.isdir(ckpt_path):
+        raise FileNotFoundError(f"no checkpoint at {ckpt_path}")
     with ocp.StandardCheckpointer() as ckptr:
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
         state = ckptr.restore(ckpt_path, abstract)
+    manifest_file = ckpt_path + ".manifest.json"
+    if verify and os.path.exists(manifest_file):
+        with open(manifest_file) as f:
+            verify_manifest(state, json.load(f), ckpt_path)
     meta_file = ckpt_path + ".meta.json"
     meta = {}
     if os.path.exists(meta_file):
         with open(meta_file) as f:
             meta = json.load(f)
     return state, meta
+
+
+def load_checkpoint(
+    template: TrainState,
+    log_name: str,
+    path: str = "./logs/",
+    epoch: int | None = None,
+    verify: bool = True,
+    fallback: bool = True,
+) -> tuple[TrainState, dict]:
+    """Restore a checkpoint into the structure of ``template``.
+
+    Default (``epoch=None``): try whatever "latest" points at, verify it
+    against its manifest, and — when the pointer dangles or the payload is
+    corrupt — fall back through older epoch checkpoints (newest first) with
+    a warning per skipped candidate. Raises ``FileNotFoundError`` naming the
+    run dir when nothing under it is loadable (including the never-written
+    case), instead of surfacing an orbax traceback. An explicit ``epoch``
+    pins exactly that checkpoint: no fallback, corruption raises."""
+    base = _ckpt_dir(log_name, path)
+    run_dir = os.path.abspath(os.path.join(path, log_name))
+    if epoch is not None:
+        target = os.path.join(base, f"epoch_{epoch}")
+        if not os.path.isdir(target):
+            raise FileNotFoundError(
+                f"no epoch-{epoch} checkpoint under {run_dir} "
+                f"(looked for {target})"
+            )
+        return _restore_one(target, template, verify)
+
+    latest = os.path.join(base, "latest")
+    target = os.path.realpath(latest) if os.path.islink(latest) or os.path.exists(latest) else None
+    candidates = []
+    if target is not None and os.path.isdir(target):
+        candidates.append(target)
+    elif target is not None and fallback:
+        warnings.warn(
+            f"checkpoint pointer {latest} dangles (target {target} is "
+            "missing) — falling back to older epoch checkpoints"
+        )
+    # fallback=False pins exactly what "latest" names: a dangling pointer
+    # must raise, never silently restore a different (older) epoch
+    if fallback:
+        for cand in _epoch_candidates(base):
+            # realpath for the dedup: candidates[0] is realpath("latest"),
+            # and when the logs path itself traverses a symlink the abspath
+            # spelling of the same dir would slip past `not in` and get
+            # restored + CRC'd a second time before any real fallback
+            cand = os.path.realpath(cand)
+            if cand not in candidates:
+                candidates.append(cand)
+
+    errors: list[str] = []
+    for i, cand in enumerate(candidates):
+        try:
+            state, meta = _restore_one(cand, template, verify)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            if not fallback:
+                raise  # pinned to "latest": propagate its real failure
+            errors.append(f"{os.path.basename(cand)}: {type(e).__name__}: {e}")
+            continue
+        if i > 0:
+            warnings.warn(
+                f"checkpoint fallback: restored {os.path.basename(cand)} "
+                f"after newer candidate(s) failed ({'; '.join(errors)})"
+            )
+        return state, meta
+
+    detail = f" (candidates failed: {'; '.join(errors)})" if errors else ""
+    raise FileNotFoundError(
+        f"no loadable checkpoint under {run_dir} — expected a 'latest' "
+        f"pointer or epoch_<N> directories in {base}{detail}"
+    )
 
 
 class Checkpoint:
@@ -80,7 +289,10 @@ class Checkpoint:
         self.best_epoch: int | None = None
 
     def __call__(self, state: TrainState, epoch: int, val_loss: float, meta=None) -> bool:
-        if epoch < self.warmup or val_loss >= self.best:
+        # non-finite is never an improvement: NaN fails every < comparison,
+        # so without this check "not (NaN >= best)" would SAVE the diverged
+        # epoch, set best=NaN, and then re-save every later epoch too
+        if epoch < self.warmup or not np.isfinite(val_loss) or val_loss >= self.best:
             return False
         self.best = val_loss
         self.best_epoch = epoch

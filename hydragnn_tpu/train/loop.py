@@ -25,7 +25,7 @@ from ..models.base import HydraModel
 from ..utils.print_utils import print_distributed, iterate_tqdm
 from ..utils import flags
 from ..utils import tracer as tr
-from .checkpoint import Checkpoint, EarlyStopping
+from .checkpoint import Checkpoint, EarlyStopping, save_checkpoint
 from .optimizer import ReduceLROnPlateau, get_learning_rate, set_learning_rate
 from .step import TrainState, make_eval_step, make_train_step, resolve_precision
 
@@ -137,6 +137,18 @@ def _local_device_count(mesh) -> int:
     return len(mesh.local_devices)
 
 
+def _dispatch_layout(mesh, put_fn=None, group_n=None):
+    """``(grouped, n_dev)``: whether the loop stacks loader batches into
+    device groups, and how many raw batches one step consumes. THE single
+    definition — train_epoch, evaluate, and the mid-epoch-resume layout
+    check in train_validate_test must all agree, or a preemption sidecar
+    records one layout and the resume validates against another (approving
+    an "exact" resume into a misaligned batch stream)."""
+    grouped = mesh is not None and put_fn is None
+    n_dev = (group_n or _local_device_count(mesh)) if grouped else 1
+    return grouped, n_dev
+
+
 # Per-step metrics stay ON DEVICE while the loop runs — a float() per step
 # would block the host on every result, serializing dispatch (the reference's
 # torch loop likewise calls .item() only on epoch aggregates,
@@ -185,6 +197,7 @@ def _accumulate(step_metrics: list, extra_keys: tuple = ()):
 def train_epoch(
     train_step, state: TrainState, loader, verbosity: int = 0, mesh=None,
     put_fn=None, group_n=None, group_put=None, steps_per_dispatch: int = 1,
+    resilience=None,
 ):
     """One training epoch; returns (state, mean loss, per-task mean losses).
     ``put_fn`` (edge-sharded mode) transfers each batch itself — no device
@@ -193,10 +206,20 @@ def train_epoch(
     placement (pipeline mode: n_micro microbatches, replicated).
     ``steps_per_dispatch`` (K>1): ``train_step`` must be the matching
     ``make_superstep(step, K)`` dispatch — each iteration consumes a
-    ``[K(, n_dev), ...]`` block of K*n_dev loader batches."""
+    ``[K(, n_dev), ...]`` block of K*n_dev loader batches.
+
+    ``resilience`` (a ``hydragnn_tpu.resilience.Resilience`` context) threads
+    the fault-tolerance layer through the epoch: chaos fault injection and
+    preemption checks at dispatch boundaries, watchdog timers around the
+    blocking device syncs, deferred skip-streak tracking over the guard's
+    ``skipped`` metric (raises ``DivergenceDetected`` past the streak limit),
+    and progress reporting (``interrupted``/``epoch_raw_done``) for mid-epoch
+    checkpointing. ``None`` (the default, and every pre-existing caller) is
+    the exact pre-resilience behavior."""
+    from contextlib import nullcontext
+
     nbatch = _max_num_batches(loader)
-    grouped = mesh is not None and put_fn is None
-    n_dev = (group_n or _local_device_count(mesh)) if grouped else 1
+    grouped, n_dev = _dispatch_layout(mesh, put_fn, group_n)
     k = max(1, int(steps_per_dispatch))
     if k > 1 and (put_fn is not None or group_put is not None):
         raise ValueError(
@@ -227,22 +250,71 @@ def train_epoch(
         it = _timed_iter(
             iterate_tqdm(loader, verbosity, desc="train", total=nbatch)
         )
+    res = resilience
+    wd = (
+        res.watchdog_guard
+        if res is not None and res.watchdog is not None
+        else (lambda what: nullcontext())
+    )
+    chaos = res.chaos if res is not None else None
+    tracker = res.new_tracker(_MAX_IN_FLIGHT) if res is not None else None
+    epoch_no = res.current_epoch if res is not None else 0
+    interrupted = False
+    dispatches = 0
     step_metrics = []  # on-device until the epoch ends (see _MAX_IN_FLIGHT)
     tr.start("train")
-    for ib, batch in enumerate(it):
-        if ib >= nbatch:
-            break
-        if put_fn is not None:
-            batch = put_fn(batch)
-        elif mesh is None and k == 1:
-            batch = jax.tree.map(jnp.asarray, batch)
-        state, metrics = train_step(state, batch)
-        step_metrics.append(metrics)
-        _backpressure(step_metrics)
-    if step_metrics:  # keep the device wait inside the train span
-        jax.block_until_ready(step_metrics[-1]["loss"])
-    tr.stop("train")
-    loss, tasks, _ = _accumulate(step_metrics)
+    try:
+        for ib, batch in enumerate(it):
+            if ib >= nbatch:
+                break
+            if res is not None and res.preempt_requested():
+                # dispatch-boundary stop: the loop saves a mid-epoch
+                # checkpoint from the progress recorded below
+                interrupted = True
+                break
+            if chaos is not None:
+                with wd("chaos dispatch hook"):
+                    batch = chaos.on_dispatch(epoch_no, ib, batch)
+            if put_fn is not None:
+                batch = put_fn(batch)
+            elif mesh is None and k == 1:
+                batch = jax.tree.map(jnp.asarray, batch)
+            state, metrics = train_step(state, batch)
+            step_metrics.append(metrics)
+            dispatches += 1
+            with wd("train step sync (backpressure)"):
+                _backpressure(step_metrics)
+            if tracker is not None and "skipped" in metrics:
+                # deferred read: only values the backpressure window already
+                # waited for are materialized, so tracking never stalls the
+                # async dispatch pipeline
+                tracker.push(metrics["skipped"])
+        if res is not None:
+            res.interrupted = interrupted
+            res.epoch_raw_done = dispatches * per_dispatch
+        if step_metrics:  # keep the device wait inside the train span
+            with wd("epoch-end device drain"):
+                jax.block_until_ready(step_metrics[-1]["loss"])
+        if tracker is not None:
+            tracker.finish()  # may raise DivergenceDetected on a tail streak
+    finally:
+        tr.stop("train")
+    has_skip = bool(step_metrics) and "skipped" in step_metrics[0]
+    loss, tasks, extras = _accumulate(
+        step_metrics, extra_keys=("skipped", "num_graphs") if has_skip else ()
+    )
+    if has_skip:
+        n_skipped = int(np.asarray(extras["skipped"]).sum())
+        if res is not None:
+            res.skipped_total += n_skipped
+        if n_skipped and float(np.asarray(extras["num_graphs"]).sum()) == 0.0:
+            # EVERY real step was guard-skipped: the 0.0 that falls out of
+            # the zero-weight accumulator is not a genuine loss — reporting
+            # it would let the best-checkpoint logic pin best=0.0 forever
+            # (and the log claim a perfect epoch). NaN is honest: nothing
+            # trained, and NaN never beats a real loss in Checkpoint.
+            loss = float("nan")
+            tasks = np.full_like(np.asarray(tasks, np.float64), np.nan)
     return state, loss, tasks
 
 
@@ -251,8 +323,7 @@ def evaluate(
     mesh=None, put_fn=None, group_n=None, group_put=None,
 ):
     """Full-split evaluation; returns (loss, per-task losses, per-head rmse)."""
-    grouped = mesh is not None and put_fn is None
-    n_dev = (group_n or _local_device_count(mesh)) if grouped else 1
+    grouped, n_dev = _dispatch_layout(mesh, put_fn, group_n)
     it = (
         _grouped(loader, n_dev, mesh, fill=True, put=group_put)
         if grouped
@@ -280,6 +351,103 @@ def evaluate(
     return loss, tasks, rmse
 
 
+def _match_placement(restored, template):
+    """Re-place an orbax-restored pytree like ``template``: NamedSharding
+    leaves go back to their mesh layout; everything else becomes an
+    UNCOMMITTED default-device array (what ``create_train_state`` produced).
+    Without this, the restored state's committed single-device placement
+    re-keys the jit cache and the first post-rollback dispatch recompiles
+    every step program — tripping HYDRAGNN_COMPILE_SENTINEL=strict and
+    burning a full XLA compile per rollback on TPU."""
+    from jax.sharding import NamedSharding
+
+    def one(r, t):
+        sh = getattr(t, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return jax.device_put(r, sh)
+        return jnp.asarray(np.asarray(r))
+
+    return jax.tree.map(one, restored, template)
+
+
+def _rollback_state(state, log_name, res, rollbacks, err, verbosity):
+    """Divergence escalation: restore the last good checkpoint with an LR
+    cut, or — past ``max_rollbacks`` consecutive rollbacks (or with nothing
+    to restore) — abort with a diagnosis instead of a NaN soup.
+
+    ``rollbacks`` counts CONSECUTIVE rollbacks (reset once an epoch
+    completes cleanly), and the LR cut compounds with it: consecutive
+    rollbacks restore the SAME checkpoint — no new one is written during a
+    failed retry — so cutting from the restored checkpoint's LR each time
+    would replay a bit-identical retry (same state, same step counter →
+    same dropout rng fold, same permutation, same LR) that deterministically
+    re-diverges. ``factor ** rollbacks`` makes each retry a genuinely
+    different trajectory."""
+    from ..resilience import TrainingDivergedError
+    from .checkpoint import load_checkpoint
+
+    if rollbacks > res.max_rollbacks:
+        raise TrainingDivergedError(
+            f"training diverged: {err}. Rolled back {rollbacks - 1} "
+            f"consecutive time(s) with compounding LR cuts (factor "
+            f"{res.rollback_lr_factor}) and the run still produces "
+            "non-finite steps — aborting. Likely causes: learning rate too "
+            "high for this precision, corrupt input samples, or a "
+            "numerically unstable loss term."
+        )
+    try:
+        good, meta = load_checkpoint(state, log_name)
+    except FileNotFoundError as e:
+        raise TrainingDivergedError(
+            f"training diverged ({err}) and no checkpoint exists to roll "
+            "back to — enable Training.Checkpoint or "
+            "Training.resilience.checkpoint_every_epoch so divergence can "
+            f"recover in place: {e}"
+        )
+    good = _match_placement(good, state)
+    old_lr = get_learning_rate(good.opt_state)
+    new_lr = old_lr * res.rollback_lr_factor ** rollbacks
+    good = good._replace(opt_state=set_learning_rate(good.opt_state, new_lr))
+    print_distributed(
+        verbosity,
+        f"divergence rollback #{rollbacks}: restored checkpoint from epoch "
+        f"{meta.get('epoch')}, LR {old_lr:.2e} -> {new_lr:.2e}",
+    )
+    return good
+
+
+def _finite_or_none(x):
+    return float(x) if x is not None and np.isfinite(x) else None
+
+
+def _preempt_meta(
+    epoch, raw_done, k_dispatch, n_dev, train_loader, scheduler,
+    checkpoint, early_stopping,
+):
+    """Sidecar metadata for a preemption checkpoint: everything a resumed
+    process needs to consume exactly the not-yet-seen batches and keep the
+    host-side scheduler/early-stop trajectories bit-identical."""
+    meta = {
+        "mid_epoch": True,
+        "epoch": int(epoch),
+        "raw_batches_done": int(raw_done),
+        "steps_per_dispatch": int(k_dispatch),
+        "n_dev": int(n_dev),
+        "shuffle_seed": int(getattr(train_loader, "seed", 0) or 0),
+        "preempted": True,
+        "scheduler": scheduler.state_dict(),
+    }
+    if checkpoint is not None:
+        meta["best_val"] = _finite_or_none(checkpoint.best)
+        meta["best_epoch"] = checkpoint.best_epoch
+    if early_stopping is not None:
+        meta["early_stop"] = {
+            "best": _finite_or_none(early_stopping.best),
+            "count": int(early_stopping.count),
+        }
+    return meta
+
+
 def train_validate_test(
     model: HydraModel,
     optimizer,
@@ -293,17 +461,32 @@ def train_validate_test(
     writer=None,
     walltime_check=None,
     mesh=None,
+    resilience=None,
+    resume_meta=None,
 ) -> TrainState:
     """The epoch loop. ``config_nn`` is the ``NeuralNetwork`` config section.
 
     With ``mesh`` set, steps run as SPMD programs over it (the state must
     already be placed with ``shard_state``); the loaders are consumed in
     device-count groups per step.
+
+    ``resilience`` (default: built from ``Training.resilience``) wires the
+    fault-tolerance layer in: the non-finite step guard wraps the train step
+    (every mode — data/FSDP/edge-sharded/pipeline — passes through it, and it
+    composes with K>1 supersteps by guarding *before* the scan fold), skip
+    streaks escalate to checkpoint rollback with an LR cut, SIGTERM/SIGUSR1
+    checkpoints mid-epoch at the next dispatch boundary, and
+    ``HYDRAGNN_FAULT_PLAN`` chaos events fire at their (epoch, dispatch)
+    coordinates. ``resume_meta`` (the sidecar dict of a preemption
+    checkpoint) resumes exactly where the interrupted run stopped.
     """
+    from ..resilience import DivergenceDetected, Resilience
+
     training = config_nn["Training"]
     num_epoch = int(training["num_epoch"])
     precision = resolve_precision(training.get("precision", "fp32"))
     edge_sharded = bool(config_nn.get("Architecture", {}).get("edge_sharding"))
+    res = resilience if resilience is not None else Resilience.from_config(training)
 
     put_fn = None
     group_n = None
@@ -378,6 +561,16 @@ def train_validate_test(
         train_step = make_train_step(model, optimizer, compute_dtype=precision)
         eval_step = make_eval_step(model, compute_dtype=precision)
 
+    # Non-finite step guard (resilience/guard.py): wrap the train step —
+    # whichever mode built it — so a NaN/Inf loss or an exploded update is
+    # skipped ON DEVICE in the same dispatch. Guarding BEFORE the
+    # superstep fold below means a K-block with a poisoned step still runs
+    # as one program (the skip rides the fill-skip machinery).
+    if res.guard_enabled:
+        from ..resilience import wrap_step_with_guard
+
+        train_step = wrap_step_with_guard(train_step)
+
     # Device-resident supersteps (Training.steps_per_dispatch /
     # HYDRAGNN_SUPERSTEP): fold K train steps into one lax.scan dispatch so
     # the host touches the device once per K batches. Edge-sharded and
@@ -419,6 +612,63 @@ def train_validate_test(
         if training.get("EarlyStopping", False)
         else None
     )
+
+    # exact mid-epoch resume (resilience): a preemption checkpoint's sidecar
+    # names the loader position; the resumed run starts at that epoch,
+    # skips exactly the already-trained raw batches, and restores the
+    # host-side scheduler/best/early-stop trajectories
+    _, n_dev_resume = _dispatch_layout(mesh, put_fn, group_n)
+    start_epoch = 0
+    resume_skip = 0
+    if resume_meta and resume_meta.get("mid_epoch"):
+        start_epoch = int(resume_meta.get("epoch", 0))
+        resume_skip = int(resume_meta.get("raw_batches_done", 0))
+        same_layout = (
+            int(resume_meta.get("steps_per_dispatch", 1)) == k_dispatch
+            and int(resume_meta.get("n_dev", 1)) == n_dev_resume
+        )
+        if resume_skip and not same_layout:
+            # the bucket-major plan order depends on (K, n_dev): a changed
+            # layout breaks raw-batch alignment, so restart the epoch (safe,
+            # not exact) rather than resume into the wrong batch stream
+            print_distributed(
+                verbosity,
+                "mid-epoch resume: dispatch layout changed (steps_per_"
+                "dispatch/device count) — restarting the interrupted epoch "
+                "from its first batch instead of an exact resume",
+            )
+            resume_skip = 0
+        ckpt_seed = resume_meta.get("shuffle_seed")
+        live_seed = int(getattr(train_loader, "seed", 0) or 0)
+        if resume_skip and ckpt_seed is not None and int(ckpt_seed) != live_seed:
+            # a different shuffle seed means a different epoch permutation:
+            # skipping raw_batches_done entries of the NEW order would
+            # double-train some samples and drop others while claiming an
+            # exact resume — restart the epoch instead
+            print_distributed(
+                verbosity,
+                f"mid-epoch resume: shuffle seed changed ({ckpt_seed} -> "
+                f"{live_seed}), the saved batch position names a different "
+                "permutation — restarting the interrupted epoch from its "
+                "first batch instead of an exact resume",
+            )
+            resume_skip = 0
+        if resume_meta.get("scheduler"):
+            scheduler.load_state_dict(resume_meta["scheduler"])
+        if checkpoint is not None and resume_meta.get("best_val") is not None:
+            checkpoint.best = float(resume_meta["best_val"])
+            checkpoint.best_epoch = resume_meta.get("best_epoch")
+        if early_stopping is not None and resume_meta.get("early_stop"):
+            es = resume_meta["early_stop"]
+            if es.get("best") is not None:
+                early_stopping.best = float(es["best"])
+            early_stopping.count = int(es.get("count", 0))
+    # sentinel warm-up horizon: the first epoch this process executes
+    # compiles everything fresh; after a PARTIAL resume the resumed tail may
+    # not have covered every pad-bucket shape, so the first FULL epoch can
+    # legitimately compile the shapes the tail skipped — exempt it too
+    # instead of strict-aborting a healthy resumed run
+    sentinel_warmup_through = start_epoch + (1 if resume_skip else 0)
     # multi-device grouping contract: tell the loaders how many consecutive
     # batches stack into one device batch, so bucketed padding coarsens its
     # bucket choice per GROUP (one shape per stack) instead of being disabled
@@ -461,7 +711,11 @@ def train_validate_test(
         if sentinel_mode is None:
             return
         delta = compile_counts()["lowerings"] - lowerings_at_epoch_start
-        if epoch == 0 or delta == 0:
+        # warm-up = the FIRST epoch this process executes (start_epoch > 0
+        # after a mid-run resume: that epoch compiles everything fresh) —
+        # and, after a PARTIAL mid-epoch resume, also the first full epoch
+        # (the resumed tail may not have covered every pad-bucket shape)
+        if epoch <= sentinel_warmup_through or delta == 0:
             return
         msg = (
             f"compile sentinel: epoch {epoch} compiled {delta} new XLA "
@@ -489,75 +743,179 @@ def train_validate_test(
 
     profiling = flags.get(flags.TRACE_LEVEL) >= 1 and _profiler("start")
 
-    for epoch in range(num_epoch):
-        os.environ["HYDRAGNN_EPOCH"] = str(epoch)  # exported for tools (reference :316)
-        if sentinel_mode is not None:
-            lowerings_at_epoch_start = compile_counts()["lowerings"]
-        train_loader.set_epoch(epoch)
-        state, train_loss, train_tasks = train_epoch(
-            dispatch_step, state, train_loader, verbosity, mesh=mesh,
-            put_fn=put_fn, group_n=group_n, group_put=group_put,
-            steps_per_dispatch=k_dispatch,
-        )
-        if profiling and epoch == 0:
-            _profiler("stop")
-            profiling = False
+    def _epoch_checkpoints(epoch: int, metric: float, saved_best: bool) -> None:
+        """Rolling last-good checkpoint (divergence-rollback target) when the
+        best-val checkpointer didn't already save this epoch; then chaos
+        epoch-scoped faults (checkpoint corruption drills)."""
+        if res.checkpoint_every_epoch and not saved_best:
+            save_checkpoint(
+                state, log_name, epoch,
+                meta={"rolling": True, "metric": _finite_or_none(metric)},
+            )
+        if res.chaos is not None:
+            res.chaos.on_epoch_end(epoch, log_name)
 
-        if skip_valtest:
+    def _preempt_boundary(epoch: int) -> bool:
+        """Epoch-boundary preemption: everything through ``epoch`` is done,
+        so the resume point is (epoch+1, batch 0)."""
+        if not res.preempt_requested():
+            return False
+        save_checkpoint(
+            state, log_name, epoch,
+            meta=_preempt_meta(
+                epoch + 1, 0, k_dispatch, n_dev_resume, train_loader,
+                scheduler, checkpoint, early_stopping,
+            ),
+        )
+        res.preempted = True
+        print_distributed(
+            verbosity, f"Preemption requested: checkpointed after epoch {epoch}"
+        )
+        return True
+
+    res.install()  # SIGTERM/SIGUSR1 -> checkpoint request (restored below)
+    rollbacks = 0
+    epoch = start_epoch
+    try:
+        while epoch < num_epoch:
+            os.environ["HYDRAGNN_EPOCH"] = str(epoch)  # exported for tools (reference :316)
+            if sentinel_mode is not None:
+                lowerings_at_epoch_start = compile_counts()["lowerings"]
+            train_loader.set_epoch(epoch)
+            res.current_epoch = epoch
+            skip = resume_skip if epoch == start_epoch else 0
+            if skip:
+                try:
+                    # AttributeError covers both a loader without the method
+                    # and a wrapper (PrefetchLoader) whose INNER loader lacks
+                    # it — hasattr on the wrapper alone would claim support
+                    # and silently double-train the resumed prefix
+                    train_loader.set_resume_point(skip)
+                except AttributeError:
+                    print_distributed(
+                        verbosity,
+                        "loader lacks set_resume_point: restarting the "
+                        "interrupted epoch from its first batch",
+                    )
+                    skip = 0
+            try:
+                state, train_loss, train_tasks = train_epoch(
+                    dispatch_step, state, train_loader, verbosity, mesh=mesh,
+                    put_fn=put_fn, group_n=group_n, group_put=group_put,
+                    steps_per_dispatch=k_dispatch, resilience=res,
+                )
+            except DivergenceDetected as e:
+                rollbacks += 1
+                res.rollbacks += 1  # run total, for diagnosis
+                state = _rollback_state(
+                    state, log_name, res, rollbacks, e, verbosity
+                )
+                # host-side LR bookkeeping must follow the device state
+                scheduler = ReduceLROnPlateau(get_learning_rate(state.opt_state))
+                res.reset_streak()  # the retry starts from a good state
+                resume_skip = 0  # a rollback restarts the epoch in full
+                continue  # retry the SAME epoch on the restored state
+            if rollbacks:
+                # the retry completed without tripping the streak limit: the
+                # LR cut worked. Reset the CONSECUTIVE counter so a later,
+                # unrelated divergence escalates from scratch instead of
+                # aborting immediately (max_rollbacks bounds consecutive
+                # failures, not lifetime recoveries).
+                rollbacks = 0
+            if profiling and epoch == start_epoch:
+                _profiler("stop")
+                profiling = False
+            if res.skipped_total:
+                print_distributed(
+                    verbosity,
+                    f"non-finite guard: {res.skipped_total} step(s) skipped "
+                    "so far this run",
+                )
+
+            if res.interrupted:
+                # mid-epoch preemption: checkpoint at the dispatch boundary
+                # with the exact loader position, then stop cleanly —
+                # run_training sees res.preempted and skips its final save
+                raw_total = _max_num_batches(train_loader)
+                raw_done = min(skip + res.epoch_raw_done, raw_total)
+                save_checkpoint(
+                    state, log_name, epoch,
+                    meta=_preempt_meta(
+                        epoch, raw_done, k_dispatch, n_dev_resume,
+                        train_loader, scheduler, checkpoint, early_stopping,
+                    ),
+                )
+                res.preempted = True
+                print_distributed(
+                    verbosity,
+                    f"Preemption requested: checkpointed mid-epoch at epoch "
+                    f"{epoch}, batch {raw_done}/{raw_total}",
+                )
+                break
+
+            if skip_valtest:
+                print_distributed(
+                    verbosity, f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}"
+                )
+                if writer is not None:
+                    writer.add_scalar("train error", train_loss, epoch)
+                # checkpoint on train loss and honor the walltime guard even
+                # without evaluation — a SLURM kill must not lose the run
+                saved = bool(checkpoint(state, epoch, train_loss)) if checkpoint is not None else False
+                _epoch_checkpoints(epoch, train_loss, saved)
+                # sentinel AFTER checkpointing: a strict-mode abort is a perf
+                # gate tripping, not state corruption — the epoch's work is
+                # valid and must survive the raise
+                _sentinel_epoch_end(epoch)
+                if walltime_check is not None and walltime_check():
+                    print_distributed(verbosity, f"Walltime guard tripped at epoch {epoch}")
+                    break
+                if _preempt_boundary(epoch):
+                    break
+                epoch += 1
+                continue
+
+            val_loss, val_tasks, _ = evaluate(
+                eval_step, state, val_loader, verbosity, "validate", mesh=mesh,
+                put_fn=put_fn, group_n=group_n, group_put=group_put,
+            )
+            test_loss, test_tasks, test_rmse = evaluate(
+                eval_step, state, test_loader, verbosity, "test", mesh=mesh,
+                put_fn=put_fn, group_n=group_n, group_put=group_put,
+            )
+
+            new_lr = scheduler.step(val_loss)
+            if new_lr != get_learning_rate(state.opt_state):
+                state = state._replace(opt_state=set_learning_rate(state.opt_state, new_lr))
+
             print_distributed(
-                verbosity, f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}"
+                verbosity,
+                f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}, "
+                f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}, LR: {new_lr:.2e}",
             )
             if writer is not None:
                 writer.add_scalar("train error", train_loss, epoch)
-            # checkpoint on train loss and honor the walltime guard even
-            # without evaluation — a SLURM kill must not lose the run
-            if checkpoint is not None:
-                checkpoint(state, epoch, train_loss)
-            # sentinel AFTER checkpointing: a strict-mode abort is a perf
-            # gate tripping, not state corruption — the epoch's work is
-            # valid and must survive the raise
+                writer.add_scalar("validate error", val_loss, epoch)
+                writer.add_scalar("test error", test_loss, epoch)
+                for itask, tl in enumerate(train_tasks):
+                    writer.add_scalar(f"train error of task {itask}", float(tl), epoch)
+
+            saved = bool(checkpoint(state, epoch, val_loss)) if checkpoint is not None else False
+            _epoch_checkpoints(epoch, val_loss, saved)
+            # sentinel AFTER checkpointing (see the skip_valtest path): a
+            # strict-mode abort must not lose the epoch's valid state
             _sentinel_epoch_end(epoch)
+            if early_stopping is not None and early_stopping(val_loss):
+                print_distributed(verbosity, f"Early stopping at epoch {epoch}")
+                break
             if walltime_check is not None and walltime_check():
                 print_distributed(verbosity, f"Walltime guard tripped at epoch {epoch}")
                 break
-            continue
-
-        val_loss, val_tasks, _ = evaluate(
-            eval_step, state, val_loader, verbosity, "validate", mesh=mesh,
-            put_fn=put_fn, group_n=group_n, group_put=group_put,
-        )
-        test_loss, test_tasks, test_rmse = evaluate(
-            eval_step, state, test_loader, verbosity, "test", mesh=mesh,
-            put_fn=put_fn, group_n=group_n, group_put=group_put,
-        )
-
-        new_lr = scheduler.step(val_loss)
-        if new_lr != get_learning_rate(state.opt_state):
-            state = state._replace(opt_state=set_learning_rate(state.opt_state, new_lr))
-
-        print_distributed(
-            verbosity,
-            f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}, "
-            f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}, LR: {new_lr:.2e}",
-        )
-        if writer is not None:
-            writer.add_scalar("train error", train_loss, epoch)
-            writer.add_scalar("validate error", val_loss, epoch)
-            writer.add_scalar("test error", test_loss, epoch)
-            for itask, tl in enumerate(train_tasks):
-                writer.add_scalar(f"train error of task {itask}", float(tl), epoch)
-
-        if checkpoint is not None:
-            checkpoint(state, epoch, val_loss)
-        # sentinel AFTER checkpointing (see the skip_valtest path): a
-        # strict-mode abort must not lose the epoch's valid state
-        _sentinel_epoch_end(epoch)
-        if early_stopping is not None and early_stopping(val_loss):
-            print_distributed(verbosity, f"Early stopping at epoch {epoch}")
-            break
-        if walltime_check is not None and walltime_check():
-            print_distributed(verbosity, f"Walltime guard tripped at epoch {epoch}")
-            break
+            if _preempt_boundary(epoch):
+                break
+            epoch += 1
+    finally:
+        res.uninstall()  # restore the previous SIGTERM/SIGUSR1 handlers
 
     if profiling:  # num_epoch == 0 or early break during the profiled epoch
         _profiler("stop")

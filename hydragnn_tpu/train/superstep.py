@@ -61,6 +61,19 @@ def resolve_steps_per_dispatch(training_cfg: dict) -> int:
 _NO_CONSTRAINT = object()
 
 
+def select_state(keep, new_state, old_state):
+    """Branchless pytree select: ``new_state`` where the scalar bool ``keep``
+    holds, else ``old_state`` — ONE fused compare+select inside the step
+    program, no extra dispatch, no retrace. The shared skip primitive of the
+    superstep's fill-batch skip and the resilience layer's non-finite step
+    guard (``resilience/guard.py``); both must revert EVERY leaf (params,
+    batch stats, optimizer moments, step counter) or AdamW decay / the
+    dropout rng fold drift on skipped steps."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(keep, n, o), new_state, old_state
+    )
+
+
 def state_shardings(state):
     """Carry-sharding pins for ``make_superstep`` (mesh path): the input
     state's per-leaf ``NamedSharding``s. Without the pin, the partitioner is
@@ -109,9 +122,7 @@ def make_superstep(
         # no-op, and the step counter drives the dropout rng fold. The
         # select keeps the whole block one static program.
         real = metrics["num_graphs"] > 0
-        new_state = jax.tree.map(
-            lambda n, o: jnp.where(real, n, o), new_state, carry
-        )
+        new_state = select_state(real, new_state, carry)
         return new_state, metrics
 
     @functools.partial(jax.jit, donate_argnums=donate)
@@ -144,4 +155,4 @@ def double_buffer(iterable, depth: int = 2):
     return background_iter(iterable, depth=depth)
 
 
-__all__ = ["make_superstep", "double_buffer"]
+__all__ = ["make_superstep", "double_buffer", "select_state"]

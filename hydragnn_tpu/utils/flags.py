@@ -56,6 +56,19 @@ SUPERSTEP = _register(Flag(
     "to 2 more staged ahead (~3K batches) and coarser (K-step) metric "
     "granularity. Edge-sharded and pipeline modes pin K=1 (their "
     "per-batch placement has no stacked [K, ...] equivalent yet)."))
+NONFINITE_GUARD = _register(Flag(
+    "HYDRAGNN_NONFINITE_GUARD", "bool", None,
+    "Force the non-finite step guard on/off (overrides "
+    "Training.resilience.nonfinite_guard). The guard select-skips NaN/Inf "
+    "optimizer updates inside the jitted step (resilience/guard.py) and "
+    "escalates to rollback-with-LR-cut after N consecutive skips."))
+FAULT_PLAN = _register(Flag(
+    "HYDRAGNN_FAULT_PLAN", "str", None,
+    "Deterministic fault-injection plan (resilience/chaos.py): a JSON list "
+    "of events or @/path/to/plan.json. Faults: nan_batch (poison node "
+    "features at an exact epoch/dispatch), sigterm (preemption rehearsal), "
+    "hang (sleep inside the watchdog-guarded dispatch), corrupt_latest "
+    "(truncate the newest checkpoint after the epoch)."))
 DUMP_TESTDATA = _register(Flag(
     "HYDRAGNN_DUMP_TESTDATA", "bool", False,
     "Dump per-rank test true/pred pickles (reference :908)."))
@@ -100,6 +113,12 @@ AFFINITY_WIDTH = _register(Flag(
     "HYDRAGNN_AFFINITY_WIDTH", "int", 1, "Cores per pinned worker."))
 AFFINITY_OFFSET = _register(Flag(
     "HYDRAGNN_AFFINITY_OFFSET", "int", 0, "First core for pinned workers."))
+
+STORE_RETRIES = _register(Flag(
+    "HYDRAGNN_STORE_RETRIES", "int", 3,
+    "Max connection attempts for a ShardedStore remote fetch; retries use "
+    "exponential backoff with jitter, so a transient TCP drop degrades to "
+    "a logged retry instead of killing the epoch. 1 disables retrying."))
 
 # -- kernels / compilation --------------------------------------------------
 FUSED_SCATTER = _register(Flag(

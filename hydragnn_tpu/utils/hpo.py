@@ -73,11 +73,23 @@ def subprocess_objective(
                 err = f"{type(exc).__name__}: {exc}"
         t1 = time.time()
         if keep_dir:
+            # status taxonomy mirrors run_hpo's: a trial the resilience
+            # layer aborted (TrainingDivergedError in its stderr) or that
+            # returned a non-finite objective is "diverged"; any other
+            # crash/timeout is "failed"
+            if np.isfinite(value):
+                status = "ok"
+            elif err and "TrainingDivergedError" in err:
+                status = "diverged"
+            elif rc == 0:
+                status = "diverged"  # clean exit, non-finite objective
+            else:
+                status = "failed"
             os.makedirs(keep_dir, exist_ok=True)
             with open(os.path.join(keep_dir, f"trial_{idx:03d}.json"), "w") as f:
                 json.dump(
-                    {"objective": value, "t_start": t0, "t_end": t1,
-                     "returncode": rc, "error": err},
+                    {"objective": value, "status": status, "t_start": t0,
+                     "t_end": t1, "returncode": rc, "error": err},
                     f,
                 )
         return value
@@ -145,6 +157,21 @@ def run_hpo(
             _set_by_path(cfg, key, val)
         return cfg
 
+    def evaluate(assignment: dict) -> tuple[float, str]:
+        """(objective value, status). A trial killed by the resilience
+        layer's divergence abort (``TrainingDivergedError``) is a *result*
+        — status ``"diverged"``, objective inf — not a sweep-crashing
+        exception; a finite value is ``"ok"``; any other non-finite value
+        also records ``"diverged"`` (the pre-existing NaN/inf objective
+        semantics, now labeled)."""
+        from ..resilience import TrainingDivergedError
+
+        try:
+            value = float(objective(build(assignment)))
+        except TrainingDivergedError:
+            return float("inf"), "diverged"
+        return value, ("ok" if np.isfinite(value) else "diverged")
+
     if backend == "optuna":
         try:
             import optuna
@@ -162,8 +189,8 @@ def run_hpo(
                     assignment[key] = trial.suggest_float(key, spec[1], spec[2])
                 else:
                     assignment[key] = trial.suggest_float(key, spec[1], spec[2], log=True)
-            value = objective(build(assignment))
-            history.append({"assignment": assignment, "value": value})
+            value, status = evaluate(assignment)
+            history.append({"assignment": assignment, "value": value, "status": status})
             return value
 
         study = optuna.create_study(direction="minimize")
@@ -185,9 +212,7 @@ def run_hpo(
                 i = 0
                 while i < n_trials or pending:
                     while i < n_trials and len(pending) < workers and not expired():
-                        fut = pool.submit(
-                            lambda a: float(objective(build(a))), assignments[i]
-                        )
+                        fut = pool.submit(evaluate, assignments[i])
                         pending[fut] = i
                         i += 1
                     if not pending:
@@ -201,16 +226,18 @@ def run_hpo(
             for i, a in enumerate(assignments):
                 if expired():
                     break
-                values[i] = float(objective(build(a)))
+                values[i] = evaluate(a)
         best_assignment, best_value = None, float("inf")
         launched = 0
-        for assignment, value in zip(assignments, values):
-            if value is None:
+        for assignment, result in zip(assignments, values):
+            if result is None:
                 continue  # budget cap: trial never launched
+            value, status = result
             launched += 1
-            history.append({"assignment": assignment, "value": value})
-            # NaN/inf objectives (diverged trials) never beat any finite value
-            if np.isfinite(value) and value < best_value:
+            history.append({"assignment": assignment, "value": value, "status": status})
+            # diverged trials (NaN/inf objective or divergence-abort) never
+            # beat any finite value — excluded from best-trial selection
+            if status == "ok" and value < best_value:
                 best_assignment, best_value = assignment, value
         if best_assignment is None:
             if launched == 0:

@@ -1,0 +1,38 @@
+"""Sanctioned non-finite step guard: detect NaN/Inf INSIDE the jitted step
+with ``jnp.isfinite`` and skip the update on device. The skip decision never
+leaves the device — no Python ``if`` on a traced value (GL002 would flag it)
+and no ``float()``/``.item()`` host sync (GL001 would flag it); the host
+reads the ``skipped`` counter from the metrics AFTER the dispatch returns,
+deferred by the in-flight window. Both on-device skip forms are clean: the
+pytree ``jnp.where`` select shown here (the superstep's fill-batch skip) and
+the single ``lax.cond`` that ``resilience/guard.py`` uses to avoid the
+per-leaf select thunks.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def make_guarded_step(train_step):
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def guarded(state, batch):
+        new_state, metrics = train_step(state, batch)
+        ok = jnp.isfinite(metrics["loss"])
+        # branchless pytree select: one fused compare+select, no retrace
+        new_state = jax.tree.map(
+            lambda n, o: jnp.where(ok, n, o), new_state, state
+        )
+        metrics = dict(metrics, skipped=jnp.logical_not(ok).astype(jnp.int32))
+        return new_state, metrics
+
+    return guarded
+
+
+def train(state, batches, step_fn):
+    guarded = make_guarded_step(step_fn)  # hoisted: built once
+    skipped = []
+    for batch in batches:
+        state, metrics = guarded(state, batch)
+        skipped.append(metrics["skipped"])  # stays on device until epoch end
+    return state, skipped

@@ -1,0 +1,826 @@
+"""Fault-tolerant training (ISSUE 5, ``hydragnn_tpu.resilience``).
+
+Every recovery path is proven END-TO-END against an injected fault, not
+assumed: a NaN step is select-skipped with the optimizer state bit-unchanged
+(and no retrace), a divergence streak rolls back to the last good checkpoint
+with an LR cut and aborts with a diagnosis past the rollback budget, a
+mid-epoch SIGTERM checkpoints at the dispatch boundary and the resumed run
+bit-matches an uninterrupted fp32 run, and a corrupted/dangling "latest"
+pointer falls back to the previous epoch instead of stranding resume.
+"""
+
+import copy
+import glob
+import json
+import os
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hydragnn_tpu
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.datasets import deterministic_graph_data
+from hydragnn_tpu.graphs.batching import GraphLoader, collate, compute_pad_spec
+from hydragnn_tpu.models import create_model_config
+from hydragnn_tpu.parallel import (
+    make_mesh,
+    make_parallel_train_step,
+    put_batch,
+    shard_state,
+    stack_device_batches,
+)
+from hydragnn_tpu.preprocess import apply_variables_of_interest
+from hydragnn_tpu.resilience import (
+    DivergenceDetected,
+    FaultPlan,
+    Resilience,
+    SkipTracker,
+    TrainingDivergedError,
+    Watchdog,
+    wrap_step_with_guard,
+)
+from hydragnn_tpu.resilience.chaos import corrupt_checkpoint, poison_batch
+from hydragnn_tpu.train import (
+    create_train_state,
+    get_learning_rate,
+    make_superstep,
+    make_train_step,
+    select_optimizer,
+)
+from hydragnn_tpu.train.checkpoint import (
+    CheckpointCorruptError,
+    load_checkpoint,
+    save_checkpoint,
+)
+from hydragnn_tpu.train.loop import train_epoch, train_validate_test
+
+from test_config import CI_CONFIG
+
+
+def setup_model(n_samples=48, batch=4):
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=n_samples, seed=9)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    opt = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    pad = compute_pad_spec(samples, batch)
+    batches = [
+        collate(samples[i * batch : (i + 1) * batch], pad)
+        for i in range(len(samples) // batch)
+    ]
+    return cfg, model, opt, batches, samples
+
+
+def _leaves(state):
+    return [np.asarray(x) for x in jax.tree.leaves(state)]
+
+
+def assert_states_equal(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.array_equal(x, y), "state leaf diverged"
+
+
+def _all_finite(state):
+    return all(
+        np.all(np.isfinite(x))
+        for x in _leaves(state)
+        if np.issubdtype(x.dtype, np.floating)
+    )
+
+
+@pytest.fixture()
+def in_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+# -- non-finite step guard ---------------------------------------------------
+
+
+def test_guard_skips_nonfinite_step_state_bit_unchanged():
+    """ISSUE 5 acceptance #1: a NaN step leaves params, optimizer moments,
+    batch stats, AND the step counter bit-identical; its metrics carry zero
+    weight plus skipped=1; the next clean step trains normally."""
+    _, model, opt, batches, _ = setup_model()
+    step = wrap_step_with_guard(make_train_step(model, opt))
+    state0 = create_train_state(model, opt, batches[0])
+    b0 = jax.tree.map(jnp.asarray, batches[0])
+
+    s1, m1 = step(state0, b0)
+    assert int(m1["skipped"]) == 0 and np.isfinite(float(m1["loss"]))
+
+    s2, m2 = step(s1, poison_batch(b0))
+    assert int(m2["skipped"]) == 1
+    assert float(m2["loss"]) == 0.0  # zeroed, not NaN: accumulate-safe
+    assert float(m2["num_graphs"]) == 0.0  # zero weight in the epoch mean
+    assert_states_equal(s1, s2)  # optimizer state bit-unchanged
+
+    s3, m3 = step(s2, jax.tree.map(jnp.asarray, batches[1]))
+    assert int(m3["skipped"]) == 0
+    assert _all_finite(s3)
+    assert int(np.asarray(s3.step)) == 2  # skipped step did not count
+
+
+def test_guard_adds_no_retrace(compile_sentinel):
+    """Poisoned and clean batches share ONE program: the skip is a fused
+    select, not a recompile (the HYDRAGNN_COMPILE_SENTINEL=strict
+    acceptance)."""
+    _, model, opt, batches, _ = setup_model()
+    step = wrap_step_with_guard(make_train_step(model, opt))
+    state = create_train_state(model, opt, batches[0])
+    b0 = jax.tree.map(jnp.asarray, batches[0])
+    bad = poison_batch(b0)
+    state, _ = step(state, b0)  # warm-up compile
+    with compile_sentinel(max_compiles=0, what="guarded step, poisoned+clean"):
+        state, m = step(state, bad)
+        state, _ = step(state, b0)
+        jax.block_until_ready(state.params)
+    assert _all_finite(state)
+
+
+def test_guard_composes_with_superstep_one_dispatch(compile_sentinel):
+    """Guard BEFORE the scan fold: a K-block with one poisoned step stays a
+    single program, and the final state bit-matches training on only the
+    clean batches (the poisoned step contributed nothing)."""
+    _, model, opt, batches, _ = setup_model()
+    raw = make_train_step(model, opt)
+    guarded = wrap_step_with_guard(raw)
+    K = 4
+    state0 = create_train_state(model, opt, batches[0])
+
+    clean = [jax.tree.map(jnp.asarray, b) for b in batches[:K]]
+    block_batches = list(clean)
+    block_batches[1] = poison_batch(block_batches[1])
+    block = jax.tree.map(jnp.asarray, stack_device_batches(block_batches))
+
+    superstep = make_superstep(guarded, K)
+    s_sup, m_sup = superstep(state0, block)
+    np.testing.assert_array_equal(np.asarray(m_sup["skipped"]), [0, 1, 0, 0])
+
+    s_ref = state0
+    for b in clean[:1] + clean[2:]:  # the clean steps only
+        s_ref, _ = raw(s_ref, b)
+    assert_states_equal(s_ref, s_sup)
+
+    block2 = jax.tree.map(jnp.asarray, stack_device_batches(clean))
+    with compile_sentinel(max_compiles=0, what="guarded superstep dispatch 2"):
+        s_sup, _ = superstep(s_sup, block2)
+        jax.block_until_ready(s_sup.params)
+
+
+def test_guard_on_8dev_mesh_parallel_step():
+    """SPMD pass-through: one poisoned shard reaches the all-reduced global
+    loss, so the WHOLE mesh's update is skipped in the same dispatch (no
+    device applies a half-poisoned gradient)."""
+    _, model, opt, batches, _ = setup_model()
+    mesh = make_mesh()
+    assert mesh.shape["data"] == 8
+    par = wrap_step_with_guard(make_parallel_train_step(model, opt, mesh))
+    state = shard_state(create_train_state(model, opt, batches[0]), mesh)
+
+    sb = put_batch(stack_device_batches(batches[:8]), mesh)
+    state, m = par(state, sb)
+    assert int(m["skipped"]) == 0
+
+    before = state
+    poisoned = poison_batch(sb)  # elementwise: sharding preserved
+    after, m2 = par(before, poisoned)
+    assert int(m2["skipped"]) == 1
+    assert_states_equal(before, after)
+
+
+def test_guard_catches_overflowed_optimizer_moment():
+    """A huge-but-not-Inf gradient can overflow an Adam moment (nu += g^2 ->
+    Inf) while the update mu/sqrt(Inf) and the params stay finite — loss and
+    params alone would pass, the Inf moment would persist forever, and that
+    parameter's updates would silently become ~0 for the rest of the run.
+    The guard probes opt_state too, so the step is skipped loudly."""
+    _, model, opt, batches, _ = setup_model()
+    raw = make_train_step(model, opt)
+    b0 = jax.tree.map(jnp.asarray, batches[0])
+
+    def moment_overflow_step(state, batch):
+        new_state, metrics = raw(state, batch)
+        blown = jax.tree.map(
+            lambda x: x * jnp.inf
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            else x,
+            new_state.opt_state,
+        )
+        return new_state._replace(opt_state=blown), metrics
+
+    step = wrap_step_with_guard(moment_overflow_step)
+    state0 = create_train_state(model, opt, batches[0])
+    s1, m1 = step(state0, b0)
+    assert int(m1["skipped"]) == 1  # loss/params finite, moments Inf
+    assert _all_finite(s1)
+
+
+def test_all_skipped_epoch_reports_nan_not_zero():
+    """An epoch whose EVERY step was guard-skipped must not report the 0.0
+    that falls out of the zero-weight accumulator: Checkpoint would pin
+    best=0.0 forever and the log would claim a perfect epoch while nothing
+    trained. NaN is honest and never beats a real loss."""
+    _, model, opt, batches, _ = setup_model()
+    step = wrap_step_with_guard(make_train_step(model, opt))
+    state0 = create_train_state(model, opt, batches[0])
+
+    poisoned = [poison_batch(jax.tree.map(jnp.asarray, b)) for b in batches[:3]]
+    s1, loss, tasks = train_epoch(step, state0, poisoned)
+    assert np.isnan(loss) and np.all(np.isnan(tasks))
+    assert_states_equal(state0, s1)  # every update skipped
+
+    # and Checkpoint must treat that NaN as "no improvement", not save it
+    # (NaN fails every >= comparison, so an unguarded best-check would save
+    # the diverged epoch AND every epoch after it)
+    from hydragnn_tpu.train.checkpoint import Checkpoint
+
+    ckpt = Checkpoint("nan_ckpt_run")
+    assert ckpt(s1, 0, loss) is False
+    assert ckpt.best == float("inf") and ckpt.best_epoch is None
+
+    # a mixed epoch (one clean step) keeps a genuine finite mean
+    mixed = poisoned[:2] + [jax.tree.map(jnp.asarray, batches[0])]
+    s2, loss2, _ = train_epoch(step, state0, mixed)
+    assert np.isfinite(loss2)
+    assert int(np.asarray(s2.step)) == 1
+
+
+def test_skip_tracker_defers_reads_and_trips():
+    t = SkipTracker(max_consecutive=3, lag=2)
+    t.push(np.int32(1))
+    t.push(np.int32(1))
+    assert t.total == 0  # nothing older than the lag window was read yet
+    t.push(np.int32(1))  # drains the first value
+    assert t.total == 1 and t.consecutive == 1
+    with pytest.raises(DivergenceDetected, match="consecutive non-finite"):
+        t.finish()
+    # superstep-stacked [K] vectors count per-step; a clean step resets
+    t2 = SkipTracker(max_consecutive=3, lag=0)
+    t2.push(np.asarray([1, 1, 0, 1], np.int32))
+    assert (t2.total, t2.consecutive) == (3, 1)
+
+
+# -- divergence rollback / abort --------------------------------------------
+
+
+def _loop_fixture(num_epoch=3, n_train=16):
+    cfg, model, opt, _, samples = setup_model()
+    nn = copy.deepcopy(cfg["NeuralNetwork"])
+    nn["Training"]["num_epoch"] = num_epoch
+    # fp32 defaults the guard off ("auto" policy); these tests exercise it
+    nn["Training"]["resilience"]["nonfinite_guard"] = True
+    train_loader = GraphLoader(samples[:n_train], 4, shuffle=False)
+    val_loader = GraphLoader(samples[n_train : n_train + 8], 4)
+    test_loader = GraphLoader(samples[n_train + 8 : n_train + 16], 4)
+    state = create_train_state(model, opt, next(iter(train_loader)))
+    return nn, model, opt, state, train_loader, val_loader, test_loader
+
+
+def test_divergence_rolls_back_to_last_good_and_recovers(in_tmp, monkeypatch):
+    """ISSUE 5 acceptance #2: epoch 1 produces only NaN steps -> the skip
+    streak trips, the loop restores the epoch-0 checkpoint with the LR cut
+    in half, re-runs epoch 1 (now clean: the fault plan is exhausted), and
+    finishes with a finite state — green under the strict compile sentinel
+    (neither guard, rollback, nor retry retraces anything)."""
+    monkeypatch.setenv("HYDRAGNN_COMPILE_SENTINEL", "strict")
+    nn, model, opt, state, tl, vl, sl = _loop_fixture()
+    res = Resilience.from_config(nn["Training"])
+    res.max_consecutive_skips = 2
+    res.checkpoint_every_epoch = True  # the rollback target
+    res.chaos = FaultPlan.parse('[{"fault": "nan_batch", "epoch": 1, "times": 4}]')
+
+    out = train_validate_test(
+        model, opt, state, tl, vl, sl, nn, "rollback_run", verbosity=0,
+        resilience=res,
+    )
+    assert res.rollbacks == 1
+    assert _all_finite(out)
+    # 3 epochs x 4 dispatches actually trained (the NaN epoch re-ran clean)
+    assert int(np.asarray(out.step)) == 12
+    lr = float(np.asarray(get_learning_rate(out.opt_state)))
+    base_lr = float(nn["Training"]["Optimizer"]["learning_rate"])
+    np.testing.assert_allclose(lr, base_lr * res.rollback_lr_factor, rtol=1e-6)
+
+
+def test_divergence_aborts_with_diagnosis_after_max_rollbacks(in_tmp):
+    """Persistent NaNs: after max_rollbacks the run raises
+    TrainingDivergedError with a diagnosis — and the last-good checkpoint on
+    disk still restores to a finite state (nothing was overwritten with
+    NaNs)."""
+    nn, model, opt, state, tl, vl, sl = _loop_fixture()
+    res = Resilience.from_config(nn["Training"])
+    res.max_consecutive_skips = 2
+    res.max_rollbacks = 1
+    res.checkpoint_every_epoch = True
+    res.chaos = FaultPlan.parse('[{"fault": "nan_batch", "epoch": 1, "times": -1}]')
+
+    with pytest.raises(TrainingDivergedError, match="consecutive non-finite"):
+        train_validate_test(
+            model, opt, state, tl, vl, sl, nn, "abort_run", verbosity=0,
+            resilience=res,
+        )
+    restored, meta = load_checkpoint(state, "abort_run")
+    assert _all_finite(restored)
+    assert meta.get("epoch") == 0  # epoch 0 was the last good state
+
+
+def test_skip_streak_persists_across_epochs(in_tmp):
+    """Escalation must fire even when every epoch is SHORTER than
+    max_consecutive_skips dispatches: the streak accumulates across epoch
+    boundaries (one persistent tracker per run). With a per-epoch tracker
+    this scenario never escalates — 4 skips/epoch, limit 6 — and the run
+    'finishes' having trained nothing."""
+    nn, model, opt, state, tl, vl, sl = _loop_fixture()  # 4 dispatches/epoch
+    res = Resilience.from_config(nn["Training"])
+    res.max_consecutive_skips = 6  # > one epoch, < two epochs
+    res.max_rollbacks = 0  # first escalation aborts
+    res.checkpoint_every_epoch = True
+    res.chaos = FaultPlan.parse(
+        '[{"fault": "nan_batch", "epoch": 1, "times": -1},'
+        ' {"fault": "nan_batch", "epoch": 2, "times": -1}]'
+    )
+    with pytest.raises(TrainingDivergedError, match="consecutive non-finite"):
+        train_validate_test(
+            model, opt, state, tl, vl, sl, nn, "streak_run", verbosity=0,
+            resilience=res,
+        )
+
+
+def test_rollback_lr_cut_compounds(in_tmp):
+    """Consecutive rollbacks restore the SAME checkpoint (no new one is
+    written during a failed retry), so the cut must compound — factor**k on
+    the k-th consecutive rollback — or every retry replays the first one
+    bit-identically and re-diverges."""
+    from hydragnn_tpu.train.loop import _rollback_state
+
+    nn, model, opt, state, tl, vl, sl = _loop_fixture()
+    res = Resilience.from_config(nn["Training"])
+    save_checkpoint(state, "compound_run", 0)
+    base_lr = float(np.asarray(get_learning_rate(state.opt_state)))
+    for k, expect in ((1, 0.5), (2, 0.25)):
+        rolled = _rollback_state(state, "compound_run", res, k, "test", 0)
+        lr = float(np.asarray(get_learning_rate(rolled.opt_state)))
+        np.testing.assert_allclose(lr, base_lr * expect, rtol=1e-6)
+
+
+def test_divergence_without_checkpoint_aborts_with_guidance(in_tmp):
+    """No checkpoint to roll back to -> the abort diagnosis says how to get
+    one, instead of a FileNotFoundError deep in orbax."""
+    nn, model, opt, state, tl, vl, sl = _loop_fixture(num_epoch=2)
+    res = Resilience.from_config(nn["Training"])
+    res.max_consecutive_skips = 2
+    res.chaos = FaultPlan.parse('[{"fault": "nan_batch", "epoch": 0, "times": -1}]')
+    with pytest.raises(TrainingDivergedError, match="checkpoint_every_epoch"):
+        train_validate_test(
+            model, opt, state, tl, vl, sl, nn, "no_ckpt_run", verbosity=0,
+            resilience=res,
+        )
+
+
+# -- preemption + exact mid-epoch resume -------------------------------------
+
+
+def _small_cfg(num_epoch=2):
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"]["Training"]["num_epoch"] = num_epoch
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = 4
+    cfg["Dataset"]["name"] = "resilience_ci"
+    return cfg
+
+
+def test_sigterm_midepoch_resume_bitmatches_uninterrupted(in_tmp, monkeypatch):
+    """ISSUE 5 acceptance #3 (kill-at-step-k): chaos SIGTERMs the run during
+    epoch 0 dispatch 1; the loop checkpoints at the dispatch boundary with
+    the loader position and run_training leaves that pointer alone; a
+    continue-run consumes exactly the not-yet-seen batches and the final
+    fp32 state bit-matches an uninterrupted run."""
+    samples = deterministic_graph_data(number_configurations=24, seed=11)
+
+    (in_tmp / "a").mkdir()
+    monkeypatch.chdir(in_tmp / "a")
+    state_a, _, _ = hydragnn_tpu.run_training(_small_cfg(), samples=samples)
+
+    (in_tmp / "b").mkdir()
+    monkeypatch.chdir(in_tmp / "b")
+    monkeypatch.setenv(
+        "HYDRAGNN_FAULT_PLAN", '[{"fault": "sigterm", "epoch": 0, "dispatch": 1}]'
+    )
+    state_b, _, aug = hydragnn_tpu.run_training(_small_cfg(), samples=samples)
+    monkeypatch.delenv("HYDRAGNN_FAULT_PLAN")
+
+    from hydragnn_tpu.config import get_log_name_config
+
+    log_name = get_log_name_config(aug)
+    metas = glob.glob(f"logs/{log_name}/checkpoints/*.meta.json")
+    assert len(metas) == 1, "preempted run must save ONLY the mid-epoch checkpoint"
+    meta = json.load(open(metas[0]))
+    assert meta["mid_epoch"] and meta["epoch"] == 0
+    assert meta["raw_batches_done"] == 2  # SIGTERM during dispatch 1 -> stop before 2
+    n_total = int(np.asarray(state_a.step))
+    assert int(np.asarray(state_b.step)) == 2 < n_total
+
+    cfg2 = _small_cfg()
+    cfg2["NeuralNetwork"]["Training"]["continue"] = 1
+    state_c, _, _ = hydragnn_tpu.run_training(cfg2, samples=samples)
+    assert int(np.asarray(state_c.step)) == n_total
+    assert_states_equal(state_a, state_c)  # fp32 bit-match
+
+
+def test_resume_restarts_epoch_on_shuffle_seed_change(in_tmp):
+    """The sidecar's shuffle_seed must be live (PrefetchLoader delegates it)
+    and VALIDATED on resume: a different seed names a different epoch
+    permutation, so skipping raw_batches_done entries of the new order would
+    double-train some samples and drop others — the loop must fall back to a
+    full epoch restart instead of a wrong 'exact' resume."""
+    from hydragnn_tpu.graphs.batching import PrefetchLoader
+
+    nn, model, opt, state0, tl, vl, sl = _loop_fixture(num_epoch=1)
+    # live delegation: the sidecar writer sees the real seed through the
+    # PrefetchLoader wrapping run_training applies
+    assert PrefetchLoader(GraphLoader(tl.samples, 4, seed=7)).seed == 7
+
+    meta = {
+        "mid_epoch": True, "epoch": 0, "raw_batches_done": 2,
+        "steps_per_dispatch": 1, "n_dev": 1, "shuffle_seed": 3,
+    }
+    # loader seed 0 != sidecar seed 3 -> full restart: all 4 dispatches run
+    out = train_validate_test(
+        model, opt, state0, tl, vl, sl, nn, "seed_mismatch", verbosity=0,
+        resume_meta=dict(meta),
+    )
+    assert int(np.asarray(out.step)) == 4
+    # matching seed -> exact resume: the 2 already-trained batches are skipped
+    nn2, model2, opt2, state2, tl2, vl2, sl2 = _loop_fixture(num_epoch=1)
+    out2 = train_validate_test(
+        model2, opt2, state2, tl2, vl2, sl2, nn2, "seed_match", verbosity=0,
+        resume_meta=dict(meta, shuffle_seed=0),
+    )
+    assert int(np.asarray(out2.step)) == 2
+
+
+def test_loader_resume_point_skips_plan_prefix():
+    """set_resume_point drops exactly the already-trained prefix in FINAL
+    plan order, one-shot: the next epoch iterates in full."""
+    _, _, _, _, samples = setup_model(n_samples=48)
+    loader = GraphLoader(samples, 4, shuffle=True)
+    loader.set_epoch(1)
+    full = [list(map(int, c)) for c, _ in loader.batch_plan()]
+    loader.set_epoch(1)
+    loader.set_resume_point(3)
+    resumed = [list(map(int, c)) for c, _ in loader.batch_plan()]
+    assert resumed == full[3:]
+    loader.set_epoch(1)
+    assert [list(map(int, c)) for c, _ in loader.batch_plan()] == full
+
+
+# -- checkpoint integrity ----------------------------------------------------
+
+
+def _tiny_state():
+    import optax
+
+    params = {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4)}
+    opt = optax.adam(1e-3)
+    from hydragnn_tpu.train.step import TrainState
+
+    return TrainState(
+        params=params,
+        batch_stats={},
+        opt_state=opt.init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def test_latest_pointer_swap_is_atomic_symlink(in_tmp):
+    state = _tiny_state()
+    save_checkpoint(state, "atomic_run", 0)
+    p1 = save_checkpoint(state._replace(step=state.step + 1), "atomic_run", 1)
+    base = os.path.dirname(p1)
+    latest = os.path.join(base, "latest")
+    assert os.path.islink(latest)
+    assert os.path.realpath(latest) == os.path.realpath(p1)
+    assert not glob.glob(os.path.join(base, "latest.tmp*")), "temp symlink leaked"
+    _, meta = load_checkpoint(state, "atomic_run")
+    assert meta["epoch"] == 1
+
+
+def test_corrupted_latest_falls_back_to_previous_epoch(in_tmp):
+    """ISSUE 5 acceptance #4: truncate a leaf file of the newest checkpoint
+    -> load_checkpoint warns and restores epoch N-1 instead of crashing (or
+    worse, silently loading garbage — the manifest checksums catch what
+    orbax tolerates)."""
+    good = _tiny_state()
+    newer = good._replace(
+        params={"w": good.params["w"] + 1.0}, step=good.step + 1
+    )
+    save_checkpoint(good, "corrupt_run", 0)
+    p1 = save_checkpoint(newer, "corrupt_run", 1)
+    corrupt_checkpoint(p1)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        restored, meta = load_checkpoint(good, "corrupt_run")
+    assert meta["epoch"] == 0
+    assert any("fallback" in str(w.message) for w in rec)
+    assert_states_equal(restored, good)
+
+
+def test_pinned_epoch_corruption_raises_not_fallback(in_tmp):
+    """An explicitly pinned epoch never falls back silently: corruption
+    surfaces as an error (the manifest check, or orbax's own failure on the
+    torn file — whichever trips first)."""
+    state = _tiny_state()
+    p0 = save_checkpoint(state, "pinned_run", 0)
+    corrupt_checkpoint(p0)
+    with pytest.raises(Exception):
+        load_checkpoint(state, "pinned_run", epoch=0)
+
+
+def test_dangling_latest_raises_clear_filenotfound(in_tmp):
+    """A dangling pointer with nothing to fall back to names the RUN DIR in
+    a FileNotFoundError — not an orbax traceback."""
+    state = _tiny_state()
+    os.makedirs("logs/dangle_run/checkpoints")
+    os.symlink("/nonexistent/epoch_7", "logs/dangle_run/checkpoints/latest")
+    with pytest.raises(FileNotFoundError, match="dangle_run"):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            load_checkpoint(state, "dangle_run")
+    # the never-written case too (reference behavior kept)
+    with pytest.raises(FileNotFoundError, match="no_such_run"):
+        load_checkpoint(state, "no_such_run")
+
+
+def test_no_fallback_pins_latest_exactly(in_tmp):
+    """``fallback=False`` means "exactly what 'latest' names": a dangling
+    pointer raises even when older epoch dirs exist (silently restoring a
+    different epoch would defeat the pin), and a corrupt target propagates
+    its real failure instead of a generic not-found."""
+    state = _tiny_state()
+    save_checkpoint(state, "pin_run", 0)
+    p1 = save_checkpoint(
+        state._replace(params={"w": state.params["w"] + 1.0}), "pin_run", 1
+    )
+    # dangling latest + existing epoch_0/epoch_1: no silent substitution
+    latest = "logs/pin_run/checkpoints/latest"
+    os.remove(latest)
+    os.symlink("/nonexistent/epoch_9", latest)
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(state, "pin_run", fallback=False)
+    # valid latest but torn payload: the corruption error itself surfaces
+    os.remove(latest)
+    os.symlink(os.path.abspath(p1), latest)
+    corrupt_checkpoint(p1)
+    with pytest.raises(Exception) as ei:
+        load_checkpoint(state, "pin_run", fallback=False)
+    assert not isinstance(ei.value, FileNotFoundError)
+
+
+# -- watchdog + chaos plumbing -----------------------------------------------
+
+
+def test_watchdog_fires_on_hang_and_stays_quiet_otherwise():
+    wd = Watchdog(0.05)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        with wd.guard("fast region"):
+            pass
+        with wd.guard("slow region"):
+            time.sleep(0.2)
+    assert wd.fired == 1 and wd.events == ["slow region"]
+    assert any("appears hung" in str(w.message) for w in rec)
+
+
+def test_chaos_hang_trips_watchdog_in_train_epoch():
+    """A hang event sleeps inside the watchdog-guarded dispatch region of
+    the real epoch loop — the timer fires, training completes."""
+    _, model, opt, batches, _ = setup_model()
+    step = make_train_step(model, opt)
+    state = create_train_state(model, opt, batches[0])
+    res = Resilience(
+        watchdog_timeout=0.05,
+        watchdog=Watchdog(0.05),
+        chaos=FaultPlan.parse(
+            '[{"fault": "hang", "epoch": 0, "dispatch": 1, "seconds": 0.2}]'
+        ),
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state, loss, _ = train_epoch(step, state, batches[:3], resilience=res)
+    assert res.watchdog.fired >= 1
+    assert np.isfinite(loss)
+
+
+def test_fault_plan_parsing():
+    plan = FaultPlan.parse(
+        '[{"fault": "nan_batch", "epoch": 2, "dispatch": 5},'
+        ' {"fault": "hang", "seconds": 0.5, "times": 3}]'
+    )
+    assert len(plan.events) == 2
+    assert plan.events[0].matches(2, 5) and not plan.events[0].matches(2, 4)
+    assert plan.events[1].dispatch is None  # every dispatch of epoch 0
+    with pytest.raises(ValueError, match="not one of"):
+        FaultPlan.parse('[{"fault": "meteor_strike"}]')
+    assert FaultPlan.from_env() is None  # unset flag -> no chaos
+
+
+def test_corrupt_latest_unlimited_fires_once_per_epoch_end(in_tmp):
+    """``times: -1`` on an epoch-scoped fault means "at every matching
+    epoch", not "loop forever within one epoch end": each on_epoch_end call
+    must terminate, firing the event exactly once."""
+    plan = FaultPlan.parse('[{"fault": "corrupt_latest", "epoch": 0, "times": -1}]')
+    plan.on_epoch_end(0, "no_such_run")  # must return, checkpoint or not
+    plan.on_epoch_end(0, "no_such_run")
+    assert plan.log == [("corrupt_latest", 0, None)] * 2
+    plan.on_epoch_end(1, "no_such_run")  # epoch 1 doesn't match
+    assert len(plan.log) == 2
+
+
+def test_fault_plan_from_file(tmp_path, monkeypatch):
+    p = tmp_path / "plan.json"
+    p.write_text('[{"fault": "sigterm", "epoch": 1}]')
+    monkeypatch.setenv("HYDRAGNN_FAULT_PLAN", f"@{p}")
+    plan = FaultPlan.from_env()
+    assert plan is not None and plan.events[0].fault == "sigterm"
+
+
+# -- satellite: ShardedStore retry-with-backoff ------------------------------
+
+
+def _two_host_store(tmp_path):
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+
+    samples = deterministic_graph_data(number_configurations=12, seed=4)
+    p0, p1 = str(tmp_path / "s0.gpk"), str(tmp_path / "s1.gpk")
+    PackedWriter(samples[:6], p0)
+    PackedWriter(samples[6:], p1)
+    srv = ShardedStore(
+        p1, 6, 12,
+        peers=[("127.0.0.1", 0, 0, 6), ("127.0.0.1", 0, 6, 12)],
+    )
+    client = ShardedStore(
+        p0, 0, 6,
+        peers=[("127.0.0.1", 0, 0, 6), ("127.0.0.1", srv.server.port, 6, 12)],
+    )
+    return srv, client
+
+
+def test_store_fetch_retries_transient_drop(tmp_path, monkeypatch):
+    """Two injected connection failures + HYDRAGNN_STORE_RETRIES=3: the
+    fetch succeeds after backoff retries (with a warning per retry) instead
+    of killing the epoch."""
+    srv, client = _two_host_store(tmp_path)
+    try:
+        monkeypatch.setenv("HYDRAGNN_STORE_RETRIES", "3")
+        orig = client._pool.acquire
+        fails = {"n": 2}
+
+        def flaky(rank, host, port):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise ConnectionError("injected transient drop")
+            return orig(rank, host, port)
+
+        monkeypatch.setattr(client._pool, "acquire", flaky)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            got = client.fetch([8])
+        assert len(got) == 1 and fails["n"] == 0
+        retries = [w for w in rec if "retry" in str(w.message)]
+        assert len(retries) == 2
+    finally:
+        srv.close()
+        client.close()
+
+
+def test_store_fetch_retry_cap_exhausts(tmp_path, monkeypatch):
+    srv, client = _two_host_store(tmp_path)
+    try:
+        monkeypatch.setenv("HYDRAGNN_STORE_RETRIES", "2")
+        monkeypatch.setattr(
+            client._pool, "acquire",
+            lambda *a: (_ for _ in ()).throw(ConnectionError("down for good")),
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(ConnectionError, match="down for good"):
+                client.fetch([8])
+    finally:
+        srv.close()
+        client.close()
+
+
+# -- satellite: HPO diverged-trial status ------------------------------------
+
+
+def test_hpo_records_diverged_trials_and_excludes_from_best():
+    """A trial killed by the divergence abort is a RESULT (status
+    'diverged', objective inf), not a sweep-crashing exception — and never
+    wins best-trial selection."""
+    from hydragnn_tpu.utils.hpo import run_hpo
+
+    base = {"NeuralNetwork": {"Architecture": {"hidden_dim": 8}}}
+    space = {"NeuralNetwork.Architecture.hidden_dim": [8, 16, 32, 64]}
+
+    def objective(cfg):
+        hd = cfg["NeuralNetwork"]["Architecture"]["hidden_dim"]
+        if hd >= 32:
+            raise TrainingDivergedError(f"hidden_dim={hd} diverged")
+        if hd == 16:
+            return float("nan")  # legacy non-finite objective path
+        return float(hd)
+
+    best_cfg, best_val, history = run_hpo(
+        base, space, objective, n_trials=12, seed=3
+    )
+    statuses = {h["status"] for h in history}
+    assert "diverged" in statuses and "ok" in statuses
+    assert best_val == 8.0
+    assert best_cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] == 8
+    for h in history:
+        if h["status"] == "diverged":
+            assert not np.isfinite(h["value"])
+
+
+def test_hpo_diverged_trials_parallel_workers():
+    from hydragnn_tpu.utils.hpo import run_hpo
+
+    base = {"x": 0}
+    space = {"x": [1, 2, 3, 4]}
+
+    def objective(cfg):
+        if cfg["x"] % 2:
+            raise TrainingDivergedError("odd diverges")
+        return float(cfg["x"])
+
+    best_cfg, best_val, history = run_hpo(
+        base, space, objective, n_trials=8, seed=0, workers=3
+    )
+    assert best_val in (2.0, 4.0)
+    assert any(h["status"] == "diverged" for h in history)
+
+
+# -- satellite: lint fixture + config schema ---------------------------------
+
+
+def test_guard_select_lint_fixture_is_clean():
+    """The sanctioned select-skip guard pattern passes the full graftlint
+    rule set (no GL001 host sync, no GL002 traced branch)."""
+    from pathlib import Path
+
+    from hydragnn_tpu.analysis import analyze
+
+    fixture = Path(__file__).parent / "fixtures" / "lint" / "guard_select_clean.py"
+    assert analyze([str(fixture)]) == []
+
+
+def test_schema_fills_resilience_defaults():
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=4, seed=1)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    res = cfg["NeuralNetwork"]["Training"]["resilience"]
+    assert res["nonfinite_guard"] == "auto"
+    assert res["max_consecutive_skips"] == 25
+    assert res["max_rollbacks"] == 2
+    assert res["rollback_lr_factor"] == 0.5
+    assert res["checkpoint_on_preempt"] is True
+    with pytest.raises(ValueError, match="resilience"):
+        bad = copy.deepcopy(CI_CONFIG)
+        bad["NeuralNetwork"]["Training"] = {"resilience": "yes please"}
+        update_config(bad, samples)
+
+
+def test_guard_auto_default_follows_precision():
+    """"auto" (the schema default) arms the guard exactly where non-finite
+    steps are routine — reduced-precision training; fp32 is opt-in and
+    skips the guard's extra step-program compile."""
+    assert Resilience.from_config({"precision": "bf16"}).guard_enabled is True
+    assert Resilience.from_config({"precision": "bfloat16"}).guard_enabled is True
+    assert Resilience.from_config({"precision": "fp32"}).guard_enabled is False
+    assert Resilience.from_config({"precision": "fp64"}).guard_enabled is False
+    assert Resilience.from_config({}).guard_enabled is False  # fp32 default
+    # an explicit setting beats the precision policy in both directions
+    assert Resilience.from_config(
+        {"precision": "fp32", "resilience": {"nonfinite_guard": True}}
+    ).guard_enabled is True
+    assert Resilience.from_config(
+        {"precision": "bf16", "resilience": {"nonfinite_guard": False}}
+    ).guard_enabled is False
+
+
+def test_env_override_disables_guard(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_NONFINITE_GUARD", "0")
+    res = Resilience.from_config({"resilience": {"nonfinite_guard": True}})
+    assert res.guard_enabled is False
+    monkeypatch.setenv("HYDRAGNN_NONFINITE_GUARD", "1")
+    res = Resilience.from_config({"resilience": {"nonfinite_guard": False}})
+    assert res.guard_enabled is True
