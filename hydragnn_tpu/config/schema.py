@@ -123,6 +123,22 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     voi = nn.setdefault("Variables_of_interest", {})
     training = nn.setdefault("Training", {})
 
+    # elastic data plane (datasets/sharded.py): the Dataset.store block's
+    # defaults ARE the StoreConfig dataclass field defaults — same
+    # single-source pattern as Training.resilience below. run_training
+    # applies the filled block to a ShardedStore passed as the dataset;
+    # HYDRAGNN_REPLICATION / HYDRAGNN_PEER_TIMEOUT override at the store.
+    ds_cfg = config.setdefault("Dataset", {})
+    store_cfg = ds_cfg.setdefault("store", {})
+    if not isinstance(store_cfg, dict):
+        raise ValueError(
+            f"Dataset.store must be a dict, got {type(store_cfg).__name__}"
+        )
+    from ..datasets.sharded import store_config_defaults
+
+    for key, val in store_config_defaults().items():
+        store_cfg.setdefault(key, val)
+
     # --- GPS / encoding defaults (reference :40-48) ---
     arch.setdefault("global_attn_engine", None)
     arch.setdefault("global_attn_type", None)

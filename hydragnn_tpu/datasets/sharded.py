@@ -23,6 +23,15 @@ each host's per-epoch stride of the shared global permutation now spans the
 WHOLE corpus (the DDStore property), fetching the ~(world-1)/world
 non-local samples from their owners.
 
+Elastic tier (replication + failover): peer ranges may OVERLAP — with
+``replication_factor=R`` every range is served by R owners holding mirror
+shards, a dead/slow owner fails over to a replica instead of stalling the
+fleet, dead peers are quarantined with re-probe backoff (a background
+prober pings them over the same protocol and lifts the quarantine when the
+host returns), and watchdog deadlines bracket every replica round-trip so
+even a byte-dribbling peer cannot park an epoch. See the ``ShardedStore``
+docstring and README "Elastic data plane".
+
 Wire format is a length-prefixed binary array framing (name + dtype str +
 shape + raw bytes per array): decode is ``np.frombuffer`` views — no
 pickle anywhere, and object dtypes are rejected on both ends, so a
@@ -37,6 +46,7 @@ transport security (TLS/WireGuard) underneath, same as MPI would.
 
 from __future__ import annotations
 
+import dataclasses
 import hmac
 import socket
 import socketserver
@@ -44,6 +54,8 @@ import struct
 import sys
 import threading
 import time
+import warnings
+import weakref
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
@@ -53,6 +65,59 @@ from ..graphs.graph import GraphSample
 from .packed import PackedDataset
 
 _HDR = struct.Struct("<q")  # payload byte length
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreConfig:
+    """Elastic data-plane knobs, single-sourced: these field defaults ARE
+    the ``Dataset.store`` config defaults (``config.update_config`` fills
+    the block from ``store_config_defaults``) and the ``ShardedStore``
+    constructor defaults — one place to tune, nothing to drift.
+
+    * ``replication_factor`` — owners expected per sample range. R=1 is the
+      PR 3 data plane (a dead owner stalls the fleet); R>1 lets ``fetch``
+      fail over to a live replica and quarantine the dead peer.
+    * ``peer_timeout`` — connect/read deadline per peer socket. A peer
+      slower than this IS down for failover purposes (gray failures stall
+      epochs exactly like crashes; the reference's MPI windows simply hang).
+    * ``probe_interval`` — how often the background prober re-pings
+      quarantined peers so a recovered host rejoins without operator action.
+    * ``quarantine_base_s``/``quarantine_cap_s`` — re-probe backoff window:
+      each consecutive failed probe doubles the quarantine, capped so a
+      rebooted host waits at most the cap before serving again.
+    """
+
+    replication_factor: int = 1
+    peer_timeout: float = 120.0
+    probe_interval: float = 2.0
+    quarantine_base_s: float = 1.0
+    quarantine_cap_s: float = 30.0
+
+
+def store_config_defaults() -> dict:
+    """``{config key: default}`` for the ``Dataset.store`` block. EVERY
+    ``StoreConfig`` field is a config key, so the mapping is derived from
+    ``dataclasses.fields`` — a hand-maintained key tuple would let a future
+    field silently drop out of the schema/apply_config plumbing."""
+    return {f.name: f.default for f in dataclasses.fields(StoreConfig)}
+
+
+# Live ShardServer registry (creation order, weakly held): the chaos
+# harness's ``dead_shard``/``slow_peer`` faults need a handle on "one of
+# the running shard servers" without threading store objects through the
+# train loop's fault hooks.
+_LIVE_SERVERS: "weakref.WeakValueDictionary[int, ShardServer]" = (
+    weakref.WeakValueDictionary()
+)
+_LIVE_SERVERS_SEQ = [0]
+_LIVE_SERVERS_LOCK = threading.Lock()
+
+
+def live_servers() -> "list[ShardServer]":
+    """Currently-running ShardServers in this process, creation order."""
+    with _LIVE_SERVERS_LOCK:
+        items = sorted(_LIVE_SERVERS.items())
+    return [srv for _, srv in items if not srv.closed]
 
 
 def _send_msg(sock: socket.socket, payload: bytes) -> None:
@@ -245,12 +310,27 @@ class ShardServer:
 
     def __init__(self, ds: PackedDataset, start: int, stop: int,
                  host: str = "0.0.0.0", auth_token: str | None = None,
-                 _test_delay_s: float = 0.0):
+                 port: int = 0, _test_delay_s: float = 0.0):
         outer = self
         tok = None if auth_token is None else auth_token.encode()
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
+                with outer._conns_lock:
+                    # registration and the close() snapshot share one lock:
+                    # a connection either lands in the snapshot (severed by
+                    # close) or observes closed here — no window where a
+                    # just-accepted socket outlives the "dead" host
+                    if outer.closed:
+                        return
+                    outer._conns.add(self.request)
+                try:
+                    self._serve_requests()
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
+
+            def _serve_requests(self) -> None:
                 try:
                     while True:
                         try:
@@ -277,6 +357,19 @@ class ShardServer:
                             _send_msg(self.request, _pack_arrays(
                                 {"n": np.asarray(-2, np.int64)}
                             ))
+                            continue
+                        if "ping" in z:
+                            # health probe (piggybacked on the fetch
+                            # protocol): answer with the served range so a
+                            # prober can verify it is talking to the peer
+                            # it thinks it is before lifting a quarantine
+                            _send_msg(self.request, _pack_arrays({
+                                "n": np.asarray(0, np.int64),
+                                "pong": np.asarray(1, np.int64),
+                                "have": np.asarray(
+                                    [outer.start, outer.stop], np.int64
+                                ),
+                            }))
                             continue
                         want = z.get("range")
                         if want is not None and (
@@ -326,14 +419,62 @@ class ShardServer:
         self.ds = ds
         self.start, self.stop = int(start), int(stop)
         self._test_delay_s = float(_test_delay_s)
-        self._srv = Server((host, 0), Handler)
+        self._conns: set[socket.socket] = set()  # live handler sockets
+        self._conns_lock = threading.Lock()
+        # port=0 picks an ephemeral port (the default); a fixed port lets a
+        # restarted host come back at the address its peers already
+        # advertise, so the prober's quarantine-lift finds it
+        self._srv = Server((host, int(port)), Handler)
         self.port = self._srv.server_address[1]
-        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self.closed = False
+
+        def _serve() -> None:
+            try:
+                self._srv.serve_forever()
+            except Exception:
+                # close() severs the listening socket out from under the
+                # select loop for an IMMEDIATE stop; the resulting EBADF
+                # is the expected way down, anything else is real
+                if not self.closed:
+                    raise
+
+        self._thread = threading.Thread(target=_serve, daemon=True)
         self._thread.start()
+        with _LIVE_SERVERS_LOCK:
+            _LIVE_SERVERS_SEQ[0] += 1
+            _LIVE_SERVERS[_LIVE_SERVERS_SEQ[0]] = self
+
+    def set_delay(self, seconds: float) -> None:
+        """Delay every response by ``seconds`` — the chaos harness's
+        ``slow_peer`` hook (same mechanism as the ``_test_delay_s`` test
+        knob): a response slower than the client's peer_timeout makes this
+        server a gray failure that fetches must fail over around."""
+        self._test_delay_s = float(seconds)
 
     def close(self) -> None:
-        self._srv.shutdown()
-        self._srv.server_close()
+        """Stop serving LIKE A DEAD HOST: immediately (no shutdown-poll
+        wait — a chaos kill inside a timed epoch must not bill the victim's
+        teardown to the client) and completely — the listening socket AND
+        every established connection are severed, so pooled client sockets
+        error on reuse instead of being silently served by a 'dead' peer."""
+        with self._conns_lock:
+            if self.closed:
+                return
+            self.closed = True
+            conns = list(self._conns)
+        self._srv.server_close()  # refuses new connects from this instant
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        # reap the serve loop off-thread: BaseServer.shutdown() blocks up
+        # to its 0.5s poll interval, which callers should never pay
+        threading.Thread(target=self._srv.shutdown, daemon=True).start()
 
 
 class _ConnPool:
@@ -345,21 +486,36 @@ class _ConnPool:
     (``distdataset.py:72-367``). Idle sockets per peer are capped; excess
     ones close on release."""
 
-    def __init__(self, max_idle_per_peer: int = 4):
+    def __init__(self, max_idle_per_peer: int = 4, timeout: float = 120.0):
         self._idle: dict[int, list[socket.socket]] = {}
         self._lock = threading.Lock()
         self._max_idle = int(max_idle_per_peer)
         self._closed = False
+        self.timeout = float(timeout)  # connect AND per-recv deadline
 
     def acquire(self, rank: int, host: str, port: int) -> tuple[socket.socket, bool]:
         """Returns (socket, from_pool). A pooled socket may have gone stale
         while idle — callers retry once on a fresh one; a FRESH connection
-        failing is a real error."""
+        failing is a real error. ``self.timeout`` bounds both the connect
+        AND every later recv on the socket (``create_connection`` leaves
+        its timeout armed), so a hung peer surfaces as ``socket.timeout`` —
+        an ``OSError`` the failover path treats as peer-down — instead of
+        parking the fetch forever."""
+        # <=0 means NO deadline (blocking), matching _guard_round_trip's
+        # "disabled for zero timeouts" convention — socket timeout 0.0 is
+        # Python's NON-BLOCKING mode, which would instantly fail every
+        # connect with BlockingIOError and quarantine healthy peers
+        timeout = self.timeout if self.timeout and self.timeout > 0 else None
         with self._lock:
             stack = self._idle.get(rank)
-            if stack:
-                return stack.pop(), True
-        return socket.create_connection((host, port), timeout=120), False
+            while stack:
+                sock = stack.pop()
+                try:
+                    sock.settimeout(timeout)  # policy may have changed
+                except OSError:
+                    continue  # closed while parked: discard, try the next
+                return sock, True
+        return socket.create_connection((host, port), timeout=timeout), False
 
     def release(self, rank: int, sock: socket.socket) -> None:
         with self._lock:
@@ -374,6 +530,18 @@ class _ConnPool:
             sock.close()
         except OSError:
             pass
+
+    def evict(self, rank: int) -> None:
+        """Close and drop every idle socket pooled for ``rank`` — called
+        when a peer is quarantined, so a later un-quarantine never checks
+        out a socket that spent the whole outage parked half-dead."""
+        with self._lock:
+            stack = self._idle.pop(rank, [])
+        for sock in stack:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def close(self) -> None:
         with self._lock:
@@ -393,6 +561,23 @@ class ShardedStore:
     ``peers``: list over ranks of ``(host, port, start, stop)``. When None,
     exchanged via ``multihost_utils.process_allgather`` (requires
     ``jax.distributed`` to be initialized).
+
+    Elastic data plane (replication + failover): peer ranges may OVERLAP —
+    with ``replication_factor=R`` every sample range is advertised by R
+    owners (each holding a mirror copy of the range in its local shard
+    file), and a remote fetch walks the owners in locality-preferring order
+    (healthy replicas first, rotated per client so load spreads; quarantined
+    peers last, as a final resort). A connect/timeout failure fails over to
+    the next replica instead of raising, quarantines the dead peer (its
+    pooled sockets are evicted, re-probe backoff doubles up to a cap), and a
+    background prober pings quarantined peers — piggybacked on the fetch
+    protocol — so a recovered host rejoins without operator action. A
+    watchdog deadline brackets every replica round-trip: a byte-dribbling
+    peer that never trips the per-``recv`` socket timeout is forcibly
+    disconnected and quarantined rather than stalling the epoch. Only
+    transport faults fail over; protocol errors (auth mismatch, misroute,
+    server-side exception) stay loud — a *reachable but wrong* peer is a
+    configuration bug replicas must not paper over.
     """
 
     def __init__(
@@ -406,6 +591,11 @@ class ShardedStore:
         bind_host: str = "0.0.0.0",
         auth_token: str | None = None,
         max_idle_conns_per_peer: int = 4,
+        replication_factor: int | None = None,
+        peer_timeout: float | None = None,
+        probe_interval: float | None = None,
+        quarantine_base_s: float | None = None,
+        quarantine_cap_s: float | None = None,
         _test_delay_s: float = 0.0,
     ):
         self.ds = PackedDataset(shard_path)
@@ -420,16 +610,61 @@ class ShardedStore:
                                   _test_delay_s=_test_delay_s)
         if peers is None:
             peers = self._allgather_peers(advertise_host)
-        self.peers = sorted(peers, key=lambda p: p[2])  # by start index
+        self.peers = sorted(peers, key=lambda p: (p[2], p[3]))
         self.total = max(p[3] for p in self.peers)
-        spans = [(p[2], p[3]) for p in self.peers]
+        # coverage check: the UNION of peer spans must cover [0, total)
+        # with no gap — overlaps (replicas) are the feature, gaps are fatal
+        spans = sorted({(p[2], p[3]) for p in self.peers})
         cursor = 0
         for s0, s1 in spans:
-            if s0 != cursor:
-                raise ValueError(f"shard ranges not contiguous: {spans}")
-            cursor = s1
+            if s0 > cursor:
+                raise ValueError(
+                    f"shard ranges leave [{cursor}, {s0}) unserved: {spans}"
+                )
+            cursor = max(cursor, s1)
         self._auth_token = auth_token
-        self._pool = _ConnPool(max_idle_conns_per_peer)
+        # elastic knobs, precedence: env flag > constructor-explicit arg >
+        # Dataset.store config block (apply_config) > StoreConfig default.
+        # Explicit args are REMEMBERED so a later apply_config of a
+        # schema-filled block (which carries defaults for every key) can't
+        # silently clobber what the caller asked for.
+        self._explicit_cfg = {
+            key
+            for key, val in (
+                ("replication_factor", replication_factor),
+                ("peer_timeout", peer_timeout),
+                ("probe_interval", probe_interval),
+                ("quarantine_base_s", quarantine_base_s),
+                ("quarantine_cap_s", quarantine_cap_s),
+            )
+            if val is not None
+        }
+        d = StoreConfig()
+        self.replication_factor = int(
+            replication_factor if replication_factor is not None
+            else d.replication_factor
+        )
+        self.peer_timeout = float(
+            peer_timeout if peer_timeout is not None else d.peer_timeout
+        )
+        self.probe_interval = float(
+            probe_interval if probe_interval is not None else d.probe_interval
+        )
+        self.quarantine_base_s = float(
+            quarantine_base_s if quarantine_base_s is not None
+            else d.quarantine_base_s
+        )
+        self.quarantine_cap_s = float(
+            quarantine_cap_s if quarantine_cap_s is not None
+            else d.quarantine_cap_s
+        )
+        self._apply_env_overrides()
+        self._check_replication()
+        # deterministic per-client replica rotation (see _replica_order):
+        # clients prefer DIFFERENT replicas so replicated reads spread
+        # instead of hammering each range's first-listed owner
+        self._rot = (self.start * 2654435761 + self.stop) % (1 << 31)
+        self._pool = _ConnPool(max_idle_conns_per_peer, timeout=self.peer_timeout)
         # the lock guards ONLY cache/telemetry bookkeeping; network
         # round-trips run outside it so concurrent fetches overlap
         self._lock = threading.Lock()
@@ -439,6 +674,65 @@ class ShardedStore:
         self._sizes_lock = threading.Lock()
         self._executor: ThreadPoolExecutor | None = None  # lazy, persistent
         self.remote_fetches = 0  # telemetry: audited by tests/bench
+        self.failover_fetches = 0  # samples re-fetched from a replica
+        self.quarantine_events = 0  # peer-down transitions observed
+        # health table: rank -> {"until", "backoff", "failures"}; a rank is
+        # quarantined while now < until AND the entry exists (the prober —
+        # or a successful last-resort fetch — removes it)
+        self._health: dict[int, dict] = {}
+        self._health_lock = threading.Lock()
+        self._probe_stop = threading.Event()
+        self._probe_thread: threading.Thread | None = None
+        self._watchdog = None  # lazy: built on first remote round-trip
+
+    def _apply_env_overrides(self) -> None:
+        from ..utils import flags
+
+        env_r = flags.get(flags.REPLICATION)
+        if env_r is not None:
+            self.replication_factor = int(env_r)
+        env_t = flags.get(flags.PEER_TIMEOUT)
+        if env_t is not None:
+            self.peer_timeout = float(env_t)
+
+    def apply_config(self, cfg: dict) -> None:
+        """Apply a ``Dataset.store`` config block (schema-filled defaults)
+        to a live store: ``run_training`` calls this so a store constructed
+        before the config was loaded still honors it. Knobs the caller set
+        EXPLICITLY at construction are kept — the schema fills the block
+        with defaults for every key, and letting those overwrite an
+        explicit ``replication_factor=2`` would silently disable the
+        elastic layer. Env flags keep the last word, matching every other
+        HYDRAGNN_* knob."""
+        for key in store_config_defaults():
+            if key in self._explicit_cfg:
+                continue
+            if cfg.get(key) is not None:
+                setattr(self, key, type(getattr(self, key))(cfg[key]))
+        self._apply_env_overrides()
+        self._pool.timeout = self.peer_timeout
+        self._watchdog = None  # rebuilt with the new deadline on next fetch
+        self._check_replication()
+
+    def _check_replication(self) -> None:
+        """Warn when any elementary range has fewer owners than the
+        configured replication factor — an under-replicated range is one
+        host loss away from stalling the fleet, which is exactly what
+        replication_factor > 1 was supposed to prevent."""
+        if self.replication_factor <= 1:
+            return
+        bounds = sorted({b for p in self.peers for b in (p[2], p[3])})
+        worst, where = None, None
+        for lo, hi in zip(bounds[:-1], bounds[1:]):
+            n = sum(1 for p in self.peers if p[2] <= lo and hi <= p[3])
+            if worst is None or n < worst:
+                worst, where = n, (lo, hi)
+        if worst is not None and worst < self.replication_factor:
+            warnings.warn(
+                f"range [{where[0]}, {where[1]}) has {worst} owner(s) but "
+                f"replication_factor={self.replication_factor} — a single "
+                "host loss can stall fetches for under-replicated ranges"
+            )
 
     def _allgather_peers(self, advertise_host: str | None):
         from jax.experimental import multihost_utils
@@ -460,13 +754,165 @@ class ShardedStore:
     def attrs(self) -> dict:
         return self.ds.attrs
 
-    def _owner(self, i: int):
-        for rank, (h, p, s0, s1) in enumerate(self.peers):
-            if s0 <= i < s1:
-                return rank, h, p, s0
-        raise IndexError(i)
+    def _is_self(self, rank: int) -> bool:
+        _, port, s0, s1 = self.peers[rank]
+        return (
+            s0 == self.start
+            and s1 == self.stop
+            and port in (0, self.server.port)
+        )
 
-    def _request(self, rank: int, host: str, port: int, **fields) -> bytes:
+    def _owners(self, i: int) -> tuple[int, ...]:
+        """Every REMOTE peer rank whose advertised span contains global
+        index ``i`` (self-entries excluded — local reads never touch the
+        network). With replication this is the replica set a fetch may
+        fail over across."""
+        ranks = tuple(
+            rank
+            for rank, (_, _, s0, s1) in enumerate(self.peers)
+            if s0 <= i < s1 and not self._is_self(rank)
+        )
+        if not ranks and not (self.start <= i < self.stop):
+            raise IndexError(i)
+        return ranks
+
+    # -- peer health / quarantine -------------------------------------------
+    def _quarantined(self, rank: int) -> bool:
+        with self._health_lock:
+            h = self._health.get(rank)
+            return h is not None and time.monotonic() < h["until"]
+
+    def _bump_quarantine(self, rank: int) -> bool:
+        """Record one more failure for ``rank`` in the health table —
+        re-probe deadline pushed out by the current backoff, backoff
+        doubled up to the cap. THE single implementation of the quarantine
+        clock, shared by the fetch path and the prober (two copies would
+        silently diverge the first time the policy is tuned). Returns True
+        when this created the entry (a fresh peer-down transition)."""
+        with self._health_lock:
+            h = self._health.get(rank)
+            fresh = h is None
+            if fresh:
+                h = self._health[rank] = {
+                    "until": 0.0, "backoff": self.quarantine_base_s,
+                    "failures": 0,
+                }
+            h["failures"] += 1
+            h["until"] = time.monotonic() + h["backoff"]
+            h["backoff"] = min(h["backoff"] * 2.0, self.quarantine_cap_s)
+        return fresh
+
+    def _mark_peer_down(self, rank: int, err: BaseException, failover: bool) -> None:
+        """Quarantine a peer after a transport failure: evict its pooled
+        sockets (they spent the outage half-dead), arm the re-probe backoff,
+        and wake the background prober so the peer rejoins automatically
+        when it answers pings again."""
+        host, port, s0, s1 = self.peers[rank]
+        announce = self._bump_quarantine(rank)
+        self._pool.evict(rank)
+        if announce:
+            with self._lock:
+                self.quarantine_events += 1
+            warnings.warn(
+                f"shard peer {host}:{port} (range [{s0}, {s1})) is down "
+                f"({type(err).__name__}: {err}): quarantined"
+                + (", failing over to a replica" if failover else
+                   " — range has NO live replica; fetches keep attempting it")
+            )
+        self._ensure_prober()
+
+    def _mark_peer_up(self, rank: int, announce: bool = False) -> None:
+        with self._health_lock:
+            was = self._health.pop(rank, None)
+        if was is not None and announce:
+            host, port, s0, s1 = self.peers[rank]
+            warnings.warn(
+                f"shard peer {host}:{port} (range [{s0}, {s1})) answers "
+                f"again after {was['failures']} failed probe(s): quarantine "
+                "lifted"
+            )
+
+    def _replica_order(self, ranks) -> list[int]:
+        """Failover order over a replica set: healthy peers first, rotated
+        by a per-client constant so different clients spread load across
+        replicas instead of all hammering the first-listed owner;
+        quarantined peers last (soonest-re-probe first) as a final resort
+        when nothing healthy is left."""
+        healthy = [r for r in ranks if not self._quarantined(r)]
+        with self._health_lock:
+            sick = sorted(
+                (r for r in ranks if r not in healthy and r in self._health),
+                key=lambda r: self._health[r]["until"],
+            )
+        sick += [r for r in ranks if r not in healthy and r not in sick]
+        if healthy:
+            rot = self._rot % len(healthy)
+            healthy = healthy[rot:] + healthy[:rot]
+        return healthy + sick
+
+    def _ensure_prober(self) -> None:
+        with self._health_lock:
+            if self._probe_thread is not None and self._probe_thread.is_alive():
+                return
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="hydragnn-shard-prober",
+                daemon=True,
+            )
+            self._probe_thread.start()
+
+    def _probe_loop(self) -> None:
+        """Background re-probe of quarantined peers (one lazy daemon
+        thread, alive only while something is quarantined): ping — a
+        protocol op the server answers without touching its dataset — and
+        lift the quarantine when the peer responds with the range it was
+        advertised for. A wrong-range pong stays quarantined: resurrecting
+        a restarted-with-different-data peer would silently serve wrong
+        samples."""
+        while not self._probe_stop.wait(self.probe_interval):
+            with self._health_lock:
+                if not self._health:
+                    # all clear: exit. Clearing the handle UNDER the lock
+                    # closes the race with _ensure_prober — a quarantine
+                    # recorded while this thread is still is_alive() but
+                    # past its exit decision must start a fresh prober,
+                    # not trust a dying one
+                    self._probe_thread = None
+                    return
+                now = time.monotonic()
+                due = [r for r, h in self._health.items() if now >= h["until"]]
+            for rank in due:
+                host, port, s0, s1 = self.peers[rank]
+                try:
+                    # watchdog-bracketed like any replica round-trip: a
+                    # quarantined peer reborn as a byte-dribbler would
+                    # otherwise wedge THE prober thread forever (it is a
+                    # singleton — a hung probe means no quarantine is ever
+                    # probe-lifted again for the rest of the process)
+                    cell: dict = {"sock": None}
+                    with self._guard_round_trip(host, port, cell):
+                        z = _unpack_arrays(self._request(
+                            rank, host, port, attempts=1, _sock_cell=cell,
+                            ping=np.asarray(1, np.int64),
+                        ))
+                    have = z.get("have")
+                    if (
+                        have is None
+                        or int(have[0]) != s0
+                        or int(have[1]) != s1
+                    ):
+                        raise ConnectionError(
+                            f"probe pong advertises range {have}, expected "
+                            f"[{s0}, {s1})"
+                        )
+                except (ConnectionError, OSError):
+                    self._bump_quarantine(rank)
+                    continue
+                self._mark_peer_up(rank, announce=True)
+
+    def _request(
+        self, rank: int, host: str, port: int, attempts: int | None = None,
+        _sock_cell: dict | None = None, **fields,
+    ) -> bytes:
         """One request/response round-trip on a pooled socket — no shared
         lock held, so concurrent callers overlap their network waits. The
         socket returns to the pool only after a clean round-trip; any error
@@ -476,28 +922,30 @@ class ShardedStore:
         always safe): a stale POOLED socket (dropped by the peer/NAT while
         parked) retries immediately on a fresh connection without counting
         an attempt; a FRESH-connection failure — connect refused/reset/
-        timed out mid-stream — retries up to ``HYDRAGNN_STORE_RETRIES``
-        total attempts with exponential backoff + jitter, warning per retry,
-        so a blip in the fabric degrades to a logged pause instead of
-        killing the epoch. The last failure re-raises."""
-        import random
-        import warnings
-
-        from ..utils import flags
+        timed out mid-stream — retries per the shared ``utils.retry``
+        policy (``HYDRAGNN_STORE_RETRIES`` total attempts, exponential
+        backoff + jitter, a warning per retry), so a blip in the fabric
+        degrades to a logged pause instead of killing the epoch. The last
+        failure re-raises. ``attempts=1`` pins a single try — the failover
+        path does its own retrying ACROSS replicas, where a per-replica
+        backoff loop would multiply the outage by the replica count.
+        ``_sock_cell`` (when given) exposes the in-flight socket so a
+        watchdog can sever a wedged round-trip from its monitor thread."""
+        from ..utils.retry import RetryPolicy, call_with_retries, store_policy
 
         if self._auth_token is not None:
             fields["token"] = np.frombuffer(self._auth_token.encode(), np.uint8)
         req = _pack_arrays(fields)
-        attempts = max(1, int(flags.get(flags.STORE_RETRIES)))
-        attempt = 0
-        delay = 0.05
-        while True:
-            try:
+        policy = (
+            store_policy() if attempts is None
+            else RetryPolicy(attempts=max(1, int(attempts)))
+        )
+
+        def attempt_once() -> bytes:
+            while True:
                 sock, from_pool = self._pool.acquire(rank, host, port)
-            except (ConnectionError, OSError) as e:
-                sock, from_pool, err = None, False, e
-            else:
-                err = None
+                if _sock_cell is not None:
+                    _sock_cell["sock"] = sock
                 try:
                     _send_msg(sock, req)
                     payload = _recv_msg(sock)
@@ -506,28 +954,124 @@ class ShardedStore:
                         sock.close()
                     except OSError:
                         pass
-                    # a socket parked idle in the pool can be dropped by the
-                    # peer/NAT at any time; retry immediately on a fresh
-                    # connection without consuming an attempt
-                    if from_pool and isinstance(e, (ConnectionError, OSError)):
+                    # a socket parked idle in the pool can be dropped by
+                    # the peer/NAT at any time; retry immediately on a
+                    # fresh connection without consuming an attempt — but
+                    # NEVER when the watchdog severed it: its one-shot
+                    # round-trip deadline is already spent, and a silent
+                    # fresh-connection retry would face the dribbling peer
+                    # unguarded (the unbounded hang the guard exists for)
+                    severed = _sock_cell is not None and _sock_cell.get("severed")
+                    if (
+                        from_pool
+                        and not severed
+                        and isinstance(e, (ConnectionError, OSError))
+                    ):
                         continue
-                    if not isinstance(e, (ConnectionError, OSError)):
-                        raise
-                    err = e
+                    raise
                 else:
                     self._pool.release(rank, sock)
                     return payload
-            attempt += 1
-            if attempt >= attempts:
-                raise err
-            sleep_s = delay * (2 ** (attempt - 1)) * (1.0 + random.random())
-            warnings.warn(
-                f"shard fetch from {host}:{port} failed "
-                f"({type(err).__name__}: {err}); retry {attempt}/"
-                f"{attempts - 1} in {sleep_s:.2f}s "
-                "(HYDRAGNN_STORE_RETRIES tunes the cap)"
-            )
-            time.sleep(sleep_s)
+
+        return call_with_retries(
+            attempt_once,
+            policy=policy,
+            retry_on=(ConnectionError, OSError),
+            describe=f"shard fetch from {host}:{port}",
+            hint="HYDRAGNN_STORE_RETRIES tunes the cap",
+        )
+
+    def _failover_request(self, owner_ranks, fields_for, what: str):
+        """One replicated request: walk the replica set in
+        ``_replica_order``, one attempt per replica per round — a transport
+        failure quarantines the peer and moves on; only when EVERY replica
+        failed does a round end, sleeping per the shared retry policy
+        before the next sweep (the fabric may be blipping, not the hosts).
+        Protocol errors (``_check_status``) raise immediately on purpose.
+
+        A watchdog deadline brackets each attempt: a peer that dribbles
+        bytes forever (resetting the per-recv socket timeout every chunk)
+        gets its socket severed from the monitor thread, which surfaces
+        here as an OSError and takes the normal quarantine+failover path.
+
+        Returns ``(decoded frame, rank, s0, s1)`` of the replica that
+        answered. ``fields_for(s0, s1)`` builds the request for an owner
+        advertising ``[s0, s1)`` — replicas of one range may be advertised
+        with different spans, and local indices are span-relative."""
+        from ..utils.retry import store_policy
+
+        policy = store_policy()
+        last_err: BaseException | None = None
+        failed_over = False
+        for rnd in range(policy.attempts):
+            if rnd:
+                sleep_s = policy.delay(rnd)
+                warnings.warn(
+                    f"{what}: every replica failed "
+                    f"({type(last_err).__name__}: {last_err}); retry round "
+                    f"{rnd}/{policy.attempts - 1} in {sleep_s:.2f}s "
+                    "(HYDRAGNN_STORE_RETRIES tunes the cap)"
+                )
+                time.sleep(sleep_s)
+            order = self._replica_order(owner_ranks)
+            for rank in order:
+                host, port, s0, s1 = self.peers[rank]
+                cell: dict = {"sock": None}
+                try:
+                    with self._guard_round_trip(host, port, cell):
+                        z = _unpack_arrays(self._request(
+                            rank, host, port, attempts=1, _sock_cell=cell,
+                            **fields_for(s0, s1),
+                        ))
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    failed_over = True
+                    self._mark_peer_down(rank, e, failover=len(order) > 1)
+                    continue
+                self._check_status(z, host, port, s0, s1)
+                self._mark_peer_up(rank)
+                if failed_over:
+                    n = int(z.get("n", np.asarray(0)))
+                    with self._lock:
+                        self.failover_fetches += max(n, 0)
+                return z, rank, s0, s1
+        raise ConnectionError(
+            f"{what}: all {len(owner_ranks)} replica(s) failed after "
+            f"{policy.attempts} round(s); last error: "
+            f"{type(last_err).__name__}: {last_err}"
+        )
+
+    def _guard_round_trip(self, host: str, port: int, cell: dict):
+        """Watchdog context for one replica round-trip: if the round-trip
+        outlives ~1.25x the peer timeout (the per-recv socket timeout never
+        fired — a dribbling peer), the monitor thread severs the in-flight
+        socket, converting the hang into the OSError the failover path
+        already handles. Disabled for non-finite/zero timeouts."""
+        from contextlib import nullcontext
+
+        if not (self.peer_timeout and np.isfinite(self.peer_timeout)):
+            return nullcontext()
+        if self._watchdog is None:
+            from ..resilience.watchdog import Watchdog
+
+            self._watchdog = Watchdog(self.peer_timeout * 1.25)
+
+        def sever() -> None:
+            # flag BEFORE closing: the blocked recv wakes the instant the
+            # socket dies, and the error path must already see "severed"
+            # (a severed pooled socket is a spent deadline, not a stale
+            # socket to quietly retry)
+            cell["severed"] = True
+            sock = cell.get("sock")
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        return self._watchdog.guard(
+            f"shard round-trip to {host}:{port}", on_expire=sever
+        )
 
     @staticmethod
     def _check_status(z: dict[str, np.ndarray], host: str, port: int,
@@ -575,18 +1119,44 @@ class ShardedStore:
 
     def _fetch_all_sizes(self) -> np.ndarray:
         out = np.zeros((self.total, 2), np.int64)
-        for rank, (host, port, s0, s1) in enumerate(self.peers):
-            if s0 == self.start and s1 == self.stop:
-                out[s0:s1] = self.ds.sample_sizes(range(s1 - s0))
+        covered = np.zeros(self.total, bool)
+        out[self.start:self.stop] = self.ds.sample_sizes(
+            range(self.stop - self.start)
+        )
+        covered[self.start:self.stop] = True
+        by_span: dict[tuple[int, int], list[int]] = {}
+        for rank, (_, _, s0, s1) in enumerate(self.peers):
+            if not self._is_self(rank):
+                by_span.setdefault((s0, s1), []).append(rank)
+        errors: list[str] = []
+        for (s0, s1), ranks in sorted(by_span.items()):
+            if covered[s0:s1].all():
+                continue  # mirror of a span already served (e.g. our own)
+            try:
+                z, _, a0, a1 = self._failover_request(
+                    ranks,
+                    lambda a0, a1: dict(
+                        idx=np.zeros((0,), np.int64),
+                        range=np.asarray([a0, a1], np.int64),
+                        sizes=np.asarray(1, np.int64),
+                    ),
+                    what=f"size table for range [{s0}, {s1})",
+                )
+            except (ConnectionError, OSError) as e:
+                # a dead span GROUP is not yet fatal: replicas advertised
+                # under different span boundaries may still cover this
+                # data (a later, finer span fills it in) — only genuinely
+                # uncovered indices after the sweep are an error
+                errors.append(f"[{s0}, {s1}): {e}")
                 continue
-            z = _unpack_arrays(self._request(
-                rank, host, port,
-                idx=np.zeros((0,), np.int64),
-                range=np.asarray([s0, s1], np.int64),
-                sizes=np.asarray(1, np.int64),
-            ))
-            self._check_status(z, host, port, s0, s1)
-            out[s0:s1] = z["sizes"]
+            out[a0:a1] = z["sizes"]
+            covered[a0:a1] = True
+        if not covered.all():
+            lo = int(np.argmin(covered))
+            raise ConnectionError(
+                f"size table incomplete: no live owner covers index {lo} "
+                f"(failed spans: {'; '.join(errors) or 'none'})"
+            )
         return out
 
     def fetch(self, indices) -> list[GraphSample]:
@@ -603,7 +1173,7 @@ class ShardedStore:
         a later cache hit). Transforms that write in place must copy
         first; transforms that build new arrays work on both."""
         out: dict[int, GraphSample] = {}
-        by_owner: dict[int, list[int]] = {}
+        by_owner: dict[tuple[int, ...], list[int]] = {}
         remote: list[int] = []
         for i in map(int, indices):
             if self.start <= i < self.stop:
@@ -620,8 +1190,11 @@ class ShardedStore:
                         hits[i] = self._cache[i]  # reference only under lock
                     elif i not in pending:
                         pending.add(i)
-                        rank = self._owner(i)[0]
-                        by_owner.setdefault(rank, []).append(i)
+                        # grouped by REPLICA SET, not single owner: every
+                        # index in a group can fail over across the same
+                        # peers, so one dead host re-routes the whole
+                        # request instead of killing the batch
+                        by_owner.setdefault(self._owners(i), []).append(i)
             # copy on hit OUTSIDE the lock (the lock serializes bookkeeping
             # only — array memcpy under it would stall concurrent workers):
             # callers mutate samples in place (transforms); the cache's
@@ -629,14 +1202,16 @@ class ShardedStore:
             for i, s in hits.items():
                 out[i] = _copy_sample(s)
         def fetch_owner(item):
-            rank, idxs = item
-            host, port, s0, s1 = self.peers[rank]
-            z = _unpack_arrays(self._request(
-                rank, host, port,
-                idx=np.asarray([i - s0 for i in idxs], np.int64),
-                range=np.asarray([s0, s1], np.int64),
-            ))
-            self._check_status(z, host, port, s0, s1)
+            ranks, idxs = item
+            z, _, _, _ = self._failover_request(
+                ranks,
+                lambda a0, a1: dict(
+                    idx=np.asarray([i - a0 for i in idxs], np.int64),
+                    range=np.asarray([a0, a1], np.int64),
+                ),
+                what=f"fetch of {len(idxs)} sample(s) from range "
+                     f"[{min(idxs)}, {max(idxs)}]",
+            )
             return idxs, _samples_from_frame(z)
 
         if len(by_owner) <= 1:
@@ -735,6 +1310,7 @@ class ShardedStore:
         )
 
     def close(self) -> None:
+        self._probe_stop.set()
         self.server.close()
         if self._executor is not None:
             self._executor.shutdown(wait=False)
@@ -749,4 +1325,10 @@ def _int_to_ip(v: int) -> str:
     return socket.inet_ntoa(v.to_bytes(4, "big"))
 
 
-__all__ = ["ShardedStore", "ShardServer"]
+__all__ = [
+    "ShardServer",
+    "ShardedStore",
+    "StoreConfig",
+    "live_servers",
+    "store_config_defaults",
+]

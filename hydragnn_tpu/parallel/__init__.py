@@ -4,6 +4,8 @@ from .mesh import (
     MODEL_AXIS,
     make_mesh,
     batch_sharding,
+    host_gather,
+    place_like,
     replicated,
     fsdp_param_specs,
     tp_param_specs,
@@ -23,6 +25,8 @@ __all__ = [
     "MODEL_AXIS",
     "make_mesh",
     "batch_sharding",
+    "host_gather",
+    "place_like",
     "replicated",
     "fsdp_param_specs",
     "tp_param_specs",

@@ -120,6 +120,69 @@ def tp_param_specs(params, mesh: Mesh, min_size_to_shard: int = 2**10):
     return jax.tree.map(spec_for, params)
 
 
+def host_gather(tree):
+    """Canonical single-replica HOST pytree from a (possibly sharded)
+    device pytree — the layout-neutral form of a ``TrainState`` that any
+    new mesh can be fed from. Fully-addressable leaves come back in ONE
+    batched ``jax.device_get``; leaves this process cannot fully address
+    (multi-host shardings) are materialized via
+    ``multihost_utils.process_allgather``, so every host ends with the
+    complete logical value. Non-array leaves pass through untouched."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    local_idx = [
+        i for i, x in enumerate(flat)
+        if hasattr(x, "shape") and getattr(x, "is_fully_addressable", True)
+    ]
+    fetched = jax.device_get([flat[i] for i in local_idx])
+    out = list(flat)
+    for i, a in zip(local_idx, fetched):
+        out[i] = np.asarray(a)
+    remote_idx = [
+        i for i, x in enumerate(flat)
+        if hasattr(x, "shape") and not getattr(x, "is_fully_addressable", True)
+    ]
+    if remote_idx:
+        # ONE allgather over all non-addressable leaves as a single pytree
+        # — per-leaf collectives would serialize hundreds of cross-host
+        # round-trips on the restore path this function exists to serve
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(
+            [flat[i] for i in remote_idx], tiled=True
+        )
+        for i, a in zip(remote_idx, gathered):
+            out[i] = np.asarray(a)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def place_like(tree, template):
+    """Re-place ``tree``'s leaves with ``template``'s layout: NamedSharding
+    leaves go to their mesh via ``jax.device_put`` (resharding across a
+    DIFFERENT device count/mesh than the values came from — the elastic
+    resume path); everything else becomes an UNCOMMITTED default-device
+    array, exactly what ``create_train_state`` produced. Keeping restored
+    state's placement identical to fresh state matters beyond correctness:
+    a committed single-device placement would re-key the jit cache and the
+    first post-restore dispatch would recompile every step program."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(r, t):
+        sh = getattr(t, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            # hand device_put the value AS IS: host arrays place directly,
+            # and device arrays reshard without a host round-trip — a
+            # device_get here would both waste a full-params host copy per
+            # call and CRASH on multi-process leaves this host cannot
+            # fully address (the rollback path restores those)
+            return jax.device_put(r, sh)
+        return jnp.asarray(np.asarray(r))
+
+    return jax.tree.map(one, tree, template)
+
+
 def fsdp_param_specs(params, mesh: Mesh, min_size_to_shard: int = 2**14):
     """ZeRO-3-style parameter sharding: biggest divisible axis -> data axis."""
     n_data = mesh.shape[DATA_AXIS]

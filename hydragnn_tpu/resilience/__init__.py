@@ -30,9 +30,18 @@ through the train loop, superstep, checkpoint, and data layers:
   batches, so kill-at-step-k + resume bit-matches an uninterrupted fp32 run.
 * **Fault injection** (``chaos.py``, ``HYDRAGNN_FAULT_PLAN``): deterministic
   NaN batches, mid-epoch SIGTERM, hung dispatches (watched by ``watchdog.py``
-  timers around the device syncs), and checkpoint corruption — so
-  ``tests/test_resilience.py`` proves every recovery path end-to-end instead
+  timers around the device syncs), checkpoint corruption, and — for the
+  elastic data plane — ``dead_shard`` (kill a live ``ShardServer`` mid-epoch,
+  the host-loss drill) and ``slow_peer`` (delay a server past the fetch
+  timeout, the gray-failure drill) — so ``tests/test_resilience.py`` and
+  ``tests/test_elastic.py`` prove every recovery path end-to-end instead
   of trusting it.
+* **Elastic data plane + layout-aware resume** (``datasets/sharded.py``,
+  ``train/checkpoint.py``): with ``replication_factor`` > 1 a dead shard
+  owner fails over to a replica (quarantine + background re-probe, watchdog
+  deadlines around each replica round-trip), and a mid-epoch checkpoint
+  resumes EXACTLY onto a different device count — the interrupted epoch
+  finishes on the saved logical update grid resharded over the new mesh.
 
 Mode coverage: the guard wraps any ``(state, batch) -> (state, metrics)``
 step, so data-parallel, FSDP, edge-sharded, and pipeline steps all pass

@@ -12,7 +12,9 @@ Plan format — a JSON list of events (inline, or ``@/path/to/plan.json``)::
       {"fault": "nan_batch", "epoch": 0, "dispatch": 3},
       {"fault": "sigterm",   "epoch": 1, "dispatch": 5},
       {"fault": "hang",      "epoch": 0, "dispatch": 2, "seconds": 1.5},
-      {"fault": "corrupt_latest", "epoch": 0}
+      {"fault": "corrupt_latest", "epoch": 0},
+      {"fault": "dead_shard", "epoch": 0, "dispatch": 4, "peer": 1},
+      {"fault": "slow_peer",  "epoch": 0, "dispatch": 2, "peer": 0, "seconds": 5}
     ]'
 
 * ``nan_batch`` — multiply the batch's node features by NaN *after* device
@@ -27,6 +29,15 @@ Plan format — a JSON list of events (inline, or ``@/path/to/plan.json``)::
 * ``corrupt_latest`` — at the end of the matching epoch, truncate the
   largest leaf file of the checkpoint "latest" points to, so the next
   restore must take the manifest-verified fallback path.
+* ``dead_shard`` — close the ``peer``-th live ``ShardServer`` in this
+  process (creation order) mid-epoch: the host-loss drill for the elastic
+  data plane. With ``replication_factor`` > 1 the epoch must complete with
+  every sample fetched from a replica; with R=1 it proves the
+  retry/diagnosis path.
+* ``slow_peer`` — delay every response of the ``peer``-th live server by
+  ``seconds``: the gray-failure drill. A delay past the client's
+  ``peer_timeout`` must escalate to quarantine + failover, not a stuck
+  epoch.
 
 ``dispatch`` omitted/null matches every dispatch of the epoch; ``times``
 caps how often an event fires (default 1; -1 = unlimited).
@@ -38,10 +49,14 @@ import dataclasses
 import json
 import os
 import signal
+import sys
 import time
 from pathlib import Path
 
-_FAULTS = ("nan_batch", "sigterm", "hang", "corrupt_latest")
+_FAULTS = (
+    "nan_batch", "sigterm", "hang", "corrupt_latest", "dead_shard",
+    "slow_peer",
+)
 
 
 @dataclasses.dataclass
@@ -49,8 +64,9 @@ class FaultEvent:
     fault: str
     epoch: int = 0
     dispatch: int | None = None  # None = every dispatch of the epoch
-    seconds: float = 1.0  # hang only
+    seconds: float = 1.0  # hang / slow_peer
     times: int = 1  # -1 = unlimited
+    peer: int = 0  # dead_shard / slow_peer: index into live_servers()
 
     def matches(self, epoch: int, dispatch: int | None) -> bool:
         if self.times == 0 or self.epoch != epoch:
@@ -96,6 +112,7 @@ class FaultPlan:
                     ),
                     seconds=float(e.get("seconds", 1.0)),
                     times=int(e.get("times", 1)),
+                    peer=int(e.get("peer", 0)),
                 )
             )
         return FaultPlan(events)
@@ -127,6 +144,12 @@ class FaultPlan:
             time.sleep(ev.seconds)
         if self._take("sigterm", epoch, dispatch) is not None:
             os.kill(os.getpid(), signal.SIGTERM)
+        ev = self._take("dead_shard", epoch, dispatch)
+        if ev is not None:
+            _kill_live_server(ev.peer)
+        ev = self._take("slow_peer", epoch, dispatch)
+        if ev is not None:
+            _slow_live_server(ev.peer, ev.seconds)
         if self._take("nan_batch", epoch, dispatch) is not None:
             batch = poison_batch(batch)
         return batch
@@ -147,6 +170,36 @@ class FaultPlan:
             target = os.path.realpath(latest)
             if os.path.isdir(target):
                 corrupt_checkpoint(target)
+
+
+def _live_server(peer: int):
+    """The ``peer``-th live ShardServer in this process (creation order),
+    or None (with a stderr note) when the index is out of range — a chaos
+    plan naming a server that never existed is an inert event, not a crash
+    in the middle of the run being drilled."""
+    from ..datasets.sharded import live_servers
+
+    servers = live_servers()
+    if 0 <= peer < len(servers):
+        return servers[peer]
+    print(
+        f"[chaos] no live ShardServer at index {peer} "
+        f"({len(servers)} registered); fault skipped",
+        file=sys.stderr,
+    )
+    return None
+
+
+def _kill_live_server(peer: int) -> None:
+    srv = _live_server(peer)
+    if srv is not None:
+        srv.close()  # connections refuse from here on: the host-loss drill
+
+
+def _slow_live_server(peer: int, seconds: float) -> None:
+    srv = _live_server(peer)
+    if srv is not None:
+        srv.set_delay(seconds)  # gray failure: alive but past any deadline
 
 
 def poison_batch(batch):

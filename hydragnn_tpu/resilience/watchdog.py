@@ -11,6 +11,18 @@ breadcrumb while the main thread is still blocked. It deliberately does NOT
 try to kill the sync — interrupting XLA mid-collective corrupts the runtime;
 detection + diagnosis is the job, the scheduler owns the kill.
 
+The elastic data plane (``datasets/sharded.py``) reuses the same timers
+around replica round-trips, with two extensions this module grew for it:
+
+* **concurrent guards** — N prefetch workers each bracket their own fetch,
+  so the armed deadlines are a table keyed by a per-guard token, not a
+  single slot (which concurrent regions would silently clobber — only the
+  last-armed region would ever be watched);
+* **per-guard ``on_expire``** — a guard can carry its own escalation
+  callback (the store severs the wedged socket, turning a byte-dribbling
+  peer into an ordinary connection error that quarantines + fails over).
+  Unlike a device sync, a TCP round-trip CAN be interrupted safely.
+
 ONE long-lived daemon monitor thread serves every guarded region (lazily
 started, parked on a condition variable while nothing is armed): the loop
 enters a guard 2+ times per dispatch, and spawning/cancelling a fresh
@@ -18,12 +30,13 @@ enters a guard 2+ times per dispatch, and spawning/cancelling a fresh
 creations per second on exactly the dispatch-latency-bound path the
 superstep work exists to shrink.
 
-The chaos harness (``chaos.py`` ``hang`` events) injects a deterministic
-sleep inside a guarded region to prove the timer actually fires.
+The chaos harness (``chaos.py`` ``hang``/``slow_peer`` events) injects a
+deterministic stall inside a guarded region to prove the timer fires.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 import warnings
@@ -34,7 +47,11 @@ class Watchdog:
     """``with watchdog.guard("step sync"): jax.block_until_ready(...)`` —
     fires ``on_hang(what)`` (and a warning) if the region runs longer than
     ``timeout_s``. A zero/negative timeout disables the guard entirely
-    (zero overhead: the context manager short-circuits)."""
+    (zero overhead: the context manager short-circuits). Guards may nest
+    and run concurrently from many threads; each armed region has its own
+    deadline and fires independently, at most once. A per-guard
+    ``on_expire`` callback (no arguments) runs on expiry in addition to
+    the shared ``on_hang(what)``."""
 
     def __init__(self, timeout_s: float, on_hang=None):
         self.timeout_s = float(timeout_s)
@@ -42,11 +59,13 @@ class Watchdog:
         self.fired = 0
         self.events: list[str] = []
         self._cond = threading.Condition()
-        self._deadline: tuple[float, str] | None = None  # guarded by _cond
+        self._token = itertools.count()
+        # token -> (deadline, what, on_expire); guarded by _cond
+        self._armed: dict[int, tuple[float, str, object]] = {}
         self._thread: threading.Thread | None = None
 
     @contextmanager
-    def guard(self, what: str = "device sync"):
+    def guard(self, what: str = "device sync", on_expire=None):
         if self.timeout_s <= 0:
             yield
             return
@@ -56,43 +75,55 @@ class Watchdog:
                     target=self._monitor, name="hydragnn-watchdog", daemon=True
                 )
                 self._thread.start()
-            self._deadline = (time.monotonic() + self.timeout_s, what)
+            tok = next(self._token)
+            self._armed[tok] = (
+                time.monotonic() + self.timeout_s, what, on_expire
+            )
             self._cond.notify()
         try:
             yield
         finally:
             with self._cond:
-                self._deadline = None
+                self._armed.pop(tok, None)
                 self._cond.notify()
 
     def _monitor(self) -> None:  # daemon thread: dies with the process
         while True:
             with self._cond:
-                if self._deadline is None:
+                if not self._armed:
                     self._cond.wait()  # parked: nothing armed, zero cost
                     continue
-                t, what = self._deadline
-                remaining = t - time.monotonic()
-                if remaining > 0:
-                    self._cond.wait(remaining)
+                now = time.monotonic()
+                expired = [
+                    (tok, what, on_expire)
+                    for tok, (t, what, on_expire) in self._armed.items()
+                    if t <= now
+                ]
+                if not expired:
+                    soonest = min(t for t, _, _ in self._armed.values())
+                    self._cond.wait(soonest - now)
                     continue
-                # deadline passed with the region still armed: fire ONCE
-                # (clearing the deadline keeps a still-hung region from
+                # deadlines passed with regions still armed: fire each ONCE
+                # (dropping the entry keeps a still-hung region from
                 # re-firing every wakeup; the next guard re-arms)
-                self._deadline = None
-                self.fired += 1
-                self.events.append(what)
-            warnings.warn(
-                f"watchdog: {what} exceeded {self.timeout_s:.1f}s — a "
-                "dispatch appears hung (wedged interconnect / deadlocked "
-                "collective?); the run continues but needs attention",
-                stacklevel=2,
-            )
-            if self.on_hang is not None:
-                try:
-                    self.on_hang(what)
-                except Exception:
-                    pass  # a broken callback must not kill the monitor
+                for tok, _, _ in expired:
+                    self._armed.pop(tok, None)
+                self.fired += len(expired)
+                self.events.extend(what for _, what, _ in expired)
+            for _, what, on_expire in expired:
+                warnings.warn(
+                    f"watchdog: {what} exceeded {self.timeout_s:.1f}s — a "
+                    "dispatch appears hung (wedged interconnect / deadlocked "
+                    "collective?); the run continues but needs attention",
+                    stacklevel=2,
+                )
+                for cb in (on_expire, self.on_hang):
+                    if cb is None:
+                        continue
+                    try:
+                        cb(what) if cb is self.on_hang else cb()
+                    except Exception:
+                        pass  # a broken callback must not kill the monitor
 
 
 __all__ = ["Watchdog"]

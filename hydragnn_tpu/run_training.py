@@ -51,6 +51,14 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     # registers its device-group size on the loaders (GraphLoader.set_group),
     # which coarsens the bucket choice to one shape per stacked group
 
+    # elastic data plane: a ShardedStore passed as the dataset picks up the
+    # Dataset.store config block (replication expectations, peer timeout,
+    # quarantine/probe cadence) before any loader touches the network —
+    # env flags (HYDRAGNN_REPLICATION, HYDRAGNN_PEER_TIMEOUT) still win
+    store_cfg = config.get("Dataset", {}).get("store")
+    if store_cfg and hasattr(samples, "apply_config"):
+        samples.apply_config(store_cfg)
+
     # data loading + split (reference :90)
     train_loader, val_loader, test_loader = dataset_loading_and_splitting(
         config, samples=samples, rank=rank, world=world

@@ -20,6 +20,18 @@ Crash-safety contract (the resilience layer, ``hydragnn_tpu/resilience``):
 * ``load_checkpoint`` falls back epoch-by-epoch when "latest" dangles or the
   target is corrupt, and raises a ``FileNotFoundError`` naming the run dir
   only when nothing under it is loadable.
+
+Elastic (layout-aware) restore: the checkpoint on disk records nothing the
+new process's topology must match — restore reshards the saved arrays onto
+whatever mesh/device count the ``template`` carries. Orbax does this
+natively when the abstract pytree names the new shardings; when it cannot
+(topology-coupled failures on sharding metadata), ``_restore_one`` falls
+back to the canonical route — restore to a single-replica HOST pytree,
+then ``jax.device_put`` each leaf against the template's sharding
+(``parallel.mesh.place_like``) — so a run preempted on N devices resumes
+on M. Sidecar JSON reads retry transient filesystem errors through the
+shared ``utils.retry`` policy (network filesystems blip; a missing file
+is an answer and never retried).
 """
 
 from __future__ import annotations
@@ -45,6 +57,25 @@ class CheckpointCorruptError(RuntimeError):
 
 def _ckpt_dir(log_name: str, path: str = "./logs/") -> str:
     return os.path.abspath(os.path.join(path, log_name, "checkpoints"))
+
+
+def _read_json(path: str) -> dict:
+    """Sidecar read with the shared transient-error retry policy: an EIO
+    blip on a network filesystem retries with backoff; a missing file
+    raises immediately (absence is an answer, not a fault)."""
+    from ..utils.retry import SIDECAR_POLICY, call_with_retries
+
+    def read():
+        with open(path) as f:
+            return json.load(f)
+
+    return call_with_retries(
+        read,
+        policy=SIDECAR_POLICY,
+        retry_on=(OSError,),
+        give_up=(FileNotFoundError,),
+        describe=f"sidecar read of {os.path.basename(path)}",
+    )
 
 
 def _write_json_atomic(path: str, obj: dict) -> None:
@@ -188,18 +219,41 @@ def _epoch_candidates(base: str) -> list[str]:
 def _restore_one(ckpt_path: str, template: TrainState, verify: bool):
     if not os.path.isdir(ckpt_path):
         raise FileNotFoundError(f"no checkpoint at {ckpt_path}")
+    # layout-aware restore: the abstract pytree names the NEW layout
+    # (template's shardings), so orbax reshards the saved arrays onto it —
+    # the checkpoint does not pin the topology it was written from. If that
+    # direct route fails on sharding metadata (orbax flags cross-topology
+    # restores "unsafe" in some paths), take the canonical one: restore to
+    # a single-replica HOST pytree, then place each leaf per the template.
     with ocp.StandardCheckpointer() as ckptr:
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-        state = ckptr.restore(ckpt_path, abstract)
+        try:
+            abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+            state = ckptr.restore(ckpt_path, abstract)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except FileNotFoundError:
+            raise
+        except Exception as e:
+            from ..parallel.mesh import place_like
+
+            warnings.warn(
+                f"direct restore of {os.path.basename(ckpt_path)} onto the "
+                f"current device layout failed ({type(e).__name__}: {e}); "
+                "retrying via host-gather + device_put resharding"
+            )
+            host_abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+                if hasattr(x, "shape") else x,
+                template,
+            )
+            state = place_like(ckptr.restore(ckpt_path, host_abstract), template)
     manifest_file = ckpt_path + ".manifest.json"
     if verify and os.path.exists(manifest_file):
-        with open(manifest_file) as f:
-            verify_manifest(state, json.load(f), ckpt_path)
+        verify_manifest(state, _read_json(manifest_file), ckpt_path)
     meta_file = ckpt_path + ".meta.json"
     meta = {}
     if os.path.exists(meta_file):
-        with open(meta_file) as f:
-            meta = json.load(f)
+        meta = _read_json(meta_file)
     return state, meta
 
 

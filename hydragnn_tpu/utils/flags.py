@@ -25,7 +25,7 @@ from typing import Callable
 @dataclass(frozen=True)
 class Flag:
     name: str
-    kind: str  # bool | int | str | path
+    kind: str  # bool | int | float | str | path
     default: object
     help: str
     subsumed: str | None = None  # why the TPU design doesn't need it
@@ -68,7 +68,9 @@ FAULT_PLAN = _register(Flag(
     "of events or @/path/to/plan.json. Faults: nan_batch (poison node "
     "features at an exact epoch/dispatch), sigterm (preemption rehearsal), "
     "hang (sleep inside the watchdog-guarded dispatch), corrupt_latest "
-    "(truncate the newest checkpoint after the epoch)."))
+    "(truncate the newest checkpoint after the epoch), dead_shard (kill a "
+    "live ShardServer mid-epoch — the host-loss drill), slow_peer (delay a "
+    "server's responses past the fetch timeout — the gray-failure drill)."))
 DUMP_TESTDATA = _register(Flag(
     "HYDRAGNN_DUMP_TESTDATA", "bool", False,
     "Dump per-rank test true/pred pickles (reference :908)."))
@@ -118,7 +120,21 @@ STORE_RETRIES = _register(Flag(
     "HYDRAGNN_STORE_RETRIES", "int", 3,
     "Max connection attempts for a ShardedStore remote fetch; retries use "
     "exponential backoff with jitter, so a transient TCP drop degrades to "
-    "a logged retry instead of killing the epoch. 1 disables retrying."))
+    "a logged retry instead of killing the epoch. 1 disables retrying. "
+    "With replication > 1 each attempt is a full failover ROUND over the "
+    "live replicas of the range, so a dead owner costs one round at most."))
+REPLICATION = _register(Flag(
+    "HYDRAGNN_REPLICATION", "int", None,
+    "Expected replica count per sample range in the ShardedStore peer "
+    "table (overrides Dataset.store.replication_factor). With R>1 every "
+    "range is served by R owners and fetches fail over to a live replica "
+    "when an owner dies; under-replicated ranges warn at startup."))
+PEER_TIMEOUT = _register(Flag(
+    "HYDRAGNN_PEER_TIMEOUT", "float", None,
+    "Connect/read timeout in seconds for ShardedStore peer sockets "
+    "(overrides Dataset.store.peer_timeout; default 120). A peer slower "
+    "than this counts as DOWN: the fetch fails over to a replica and the "
+    "peer is quarantined until a background probe sees it answer again."))
 
 # -- kernels / compilation --------------------------------------------------
 FUSED_SCATTER = _register(Flag(
@@ -177,6 +193,8 @@ def _parse(flag: Flag, raw: str):
         return raw not in ("0", "false", "False")
     if flag.kind == "int":
         return int(raw)
+    if flag.kind == "float":
+        return float(raw)
     return raw
 
 

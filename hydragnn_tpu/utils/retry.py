@@ -1,0 +1,89 @@
+"""One retry policy for the whole data/resume plane.
+
+PR 3 grew an exponential-backoff-with-jitter loop inside
+``ShardedStore._request``; the elastic data plane needs the identical
+discipline in more places (replica failover rounds, checkpoint sidecar
+reads on flaky network filesystems). This module is the single
+implementation: a frozen ``RetryPolicy`` plus ``call_with_retries`` — so
+"how many attempts, how long between them, what counts as transient" is
+decided once and tested once, instead of re-derived per call site.
+
+Jitter is multiplicative (``delay * (1 + U(0, jitter))``): when a shard
+owner dies, every client notices at the same moment, and synchronized
+retries would re-stampede the replacement replica in lockstep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+import warnings
+from typing import Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """``attempts`` total tries (1 = no retrying); sleep before retry k
+    (1-based) is ``base_delay * factor**(k-1) * (1 + U(0, jitter))``."""
+
+    attempts: int = 3
+    base_delay: float = 0.05
+    factor: float = 2.0
+    jitter: float = 1.0
+
+    def delay(self, retry_no: int) -> float:
+        scale = 1.0 + random.random() * self.jitter
+        return self.base_delay * (self.factor ** (retry_no - 1)) * scale
+
+
+def store_policy() -> RetryPolicy:
+    """The ShardedStore fetch policy: attempts from ``HYDRAGNN_STORE_RETRIES``
+    (the PR 3 knob), timing constants unchanged from the inline original."""
+    from . import flags
+
+    return RetryPolicy(attempts=max(1, int(flags.get(flags.STORE_RETRIES))))
+
+
+# Sidecar JSON reads retry on transient filesystem errors (EIO blips on
+# network filesystems are routine on the clusters the resilience layer
+# targets) but never on a genuinely missing file — that is an answer, not
+# a fault, and three delayed retries would just slow every cold start.
+SIDECAR_POLICY = RetryPolicy(attempts=3, base_delay=0.05)
+
+
+def call_with_retries(
+    fn: Callable,
+    *,
+    policy: RetryPolicy,
+    retry_on: tuple = (ConnectionError, OSError),
+    give_up: tuple = (),
+    describe: str = "",
+    hint: str = "",
+):
+    """Run ``fn()``; on an exception in ``retry_on`` (and not in
+    ``give_up``), sleep per the policy and retry, warning each time, up to
+    ``policy.attempts`` total attempts. The last failure re-raises.
+    ``describe`` names the operation in the warning; ``hint`` appends a
+    remediation note (e.g. the env var that tunes the cap)."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except give_up:
+            raise
+        except retry_on as e:
+            attempt += 1
+            if attempt >= policy.attempts:
+                raise
+            sleep_s = policy.delay(attempt)
+            warnings.warn(
+                f"{describe or 'operation'} failed "
+                f"({type(e).__name__}: {e}); retry {attempt}/"
+                f"{policy.attempts - 1} in {sleep_s:.2f}s"
+                + (f" ({hint})" if hint else "")
+            )
+            time.sleep(sleep_s)
+
+
+__all__ = ["RetryPolicy", "SIDECAR_POLICY", "call_with_retries", "store_policy"]
