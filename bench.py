@@ -587,6 +587,134 @@ def bench_superstep_ab(batch_size: int, bench_steps: int, warmup: int,
     }
 
 
+def bench_population_ab(batch_size: int = 64, bench_steps: int = 24,
+                        warmup: int = 2, n_members: int = 4, k: int = 4,
+                        windows: int = 4) -> dict:
+    """Population A/B (ISSUE 8): N HPO-trial-shaped trainings (same
+    architecture, distinct learning rates) run the reference way — N
+    sequential single-member step streams — vs ONE vmapped population
+    superstep program (``train/population.py``: scan outside, vmap inside).
+    CPU-provable columns: host dispatch count for the same raw training work
+    (sequential = N*W dispatches, population = W/K — an N*K-fold reduction),
+    XLA compile count per arm (counted via the analysis sentinel's lowering
+    counters), and ABBA paired-window wall-clock with the shared
+    ``_abba_verdict`` noise floor (budget 0: 'pass' means the population arm
+    is at least as fast beyond the host's own noise — on CPU the win is
+    bounded, the dispatch/compile columns are the scale claim)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.analysis.sentinel import compile_counts
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.parallel.step import stack_device_batches
+    from hydragnn_tpu.train import (
+        create_population_state,
+        create_train_state,
+        make_population_step,
+        make_superstep,
+        make_train_step,
+        select_optimizer,
+    )
+    from hydragnn_tpu.train.optimizer import set_learning_rate
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 64
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    samples = make_qm9_like_samples(max(batch_size * 2, 256), seed=37)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    host = list(GraphLoader(samples, batch_size, shuffle=True))
+    batches = [jax.tree.map(jnp.asarray, b) for b in host]
+    n_raw = max(bench_steps - bench_steps % k, k)  # W raw steps per member
+    blocks = [
+        jax.tree.map(
+            jnp.asarray,
+            stack_device_batches([host[(i * k + j) % len(host)] for j in range(k)]),
+        )
+        for i in range(n_raw // k)
+    ]
+    jax.block_until_ready(blocks[0])
+    lrs = [1e-3 * (2.0 ** i) for i in range(n_members)]
+
+    step = make_train_step(model, optimizer)
+    # sequential arm: the SAME jitted step serves every trial (in-process
+    # best case — subprocess fleets pay the compile N times over); per-trial
+    # lr lives in opt_state, so no retrace between members
+    seq_states = []
+    for lr in lrs:
+        s = create_train_state(model, optimizer, batches[0])
+        seq_states.append(s._replace(opt_state=set_learning_rate(s.opt_state, lr)))
+    pop_step = make_superstep(
+        make_population_step(make_train_step(model, optimizer)), k
+    )
+    pstate = create_population_state(
+        model, optimizer, batches[0], n_members,
+        hyperparams={"learning_rate": lrs},
+    )
+    # compile deltas bracket each arm's WARMUP only (state init traces its
+    # own little programs and would drown the step-program count)
+    c0 = compile_counts()["lowerings"]
+    seq_states[0], _ = _time_steps(step, seq_states[0], batches, warmup)
+    compiles_seq = compile_counts()["lowerings"] - c0
+    c1 = compile_counts()["lowerings"]
+    pstate, _ = _time_steps(pop_step, pstate, blocks, 1)
+    compiles_pop = compile_counts()["lowerings"] - c1
+
+    def run_sequential():
+        t = 0.0
+        for i in range(n_members):
+            seq_states[i], dt = _time_steps(step, seq_states[i], batches, n_raw)
+            t += dt
+        return t
+
+    def run_population():
+        nonlocal pstate
+        pstate, dt = _time_steps(pop_step, pstate, blocks, n_raw // k)
+        return dt
+
+    # untimed burn-in pair (post-compile allocator/cache settle; see
+    # bench_resilience_overhead)
+    run_sequential(); run_population()
+    seq_ms, pop_ms = [], []
+    for w in range(max(windows, 1)):
+        if w % 2 == 0:
+            t_seq = run_sequential(); t_pop = run_population()
+        else:
+            t_pop = run_population(); t_seq = run_sequential()
+        seq_ms.append(1e3 * t_seq)
+        pop_ms.append(1e3 * t_pop)
+    overhead_pct, noise_pct, verdict = _abba_verdict(seq_ms, pop_ms, budget_pct=0.0)
+    disp_seq = n_members * n_raw
+    disp_pop = n_raw // k
+    return {
+        "workload": "population_ab",
+        "n_members": n_members,
+        "k": k,
+        "raw_steps_per_member": n_raw,
+        "dispatches_sequential": disp_seq,
+        "dispatches_population": disp_pop,
+        "dispatch_reduction_x": round(disp_seq / disp_pop, 2),  # = N*K
+        "compiles_sequential_arm": compiles_seq,
+        "compiles_population_arm": compiles_pop,
+        "window_ms_sequential": [round(x, 2) for x in seq_ms],
+        "window_ms_population": [round(x, 2) for x in pop_ms],
+        "population_speedup": round(
+            statistics.median(seq_ms) / statistics.median(pop_ms), 4
+        ),
+        # _abba_verdict measures B-vs-A overhead; negative = population wins.
+        # 'pass' = faster beyond the noise floor; 'inconclusive' = host too
+        # noisy to resolve wall-clock (dispatch/compile columns still stand)
+        "population_overhead_pct": round(overhead_pct, 2),
+        "noise_pct": round(noise_pct, 2),
+        "verdict": verdict,
+        "batch_size": batch_size,
+    }
+
+
 def _iqr(xs):
     s = sorted(xs)
     if len(s) < 4:  # too few windows for quartiles: full range (>= 0)
@@ -872,6 +1000,7 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
     gin = bench_gin(batch_size, steps, warmup)
     ab = bench_superstep_ab(batch_size, max(steps, k), warmup, k=k)
     guard = bench_resilience_overhead(batch_size, max(steps, 10), warmup)
+    pop = bench_population_ab(batch_size, max(steps, k), warmup, k=k)
     return {
         "workload": "cpu_smoke",
         "degraded": True,
@@ -881,6 +1010,7 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
         "collate_ms_per_batch": gin["collate_ms_per_batch"],
         "superstep_ab": ab,
         "resilience_overhead": guard,
+        "population_ab": pop,
     }
 
 
@@ -1417,6 +1547,12 @@ def child_main(status_path: str) -> None:
     # rounds already report (row continuity)
     plan.append(
         ("inference", lambda: bench_inference(batch_size, bench_steps, warmup))
+    )
+    # ISSUE 8 acceptance row: N sequential HPO trials vs one vmapped
+    # population program (dispatch/compile counts + ABBA wall-clock)
+    plan.append(
+        ("population_ab",
+         lambda: bench_population_ab(batch_size, bench_steps, warmup))
     )
     if os.getenv("BENCH_FUSED_AUTOTUNE", "1") != "0":
         # cheap kernel-only sweep BEFORE the compile-heavy arch entries, so

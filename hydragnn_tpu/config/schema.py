@@ -248,6 +248,29 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     # K train steps per device dispatch (train/superstep.py); env override
     # HYDRAGNN_SUPERSTEP wins at loop time
     training.setdefault("steps_per_dispatch", 1)
+    # population training (train/population.py): N ensemble members / HPO
+    # trials vmapped into one jitted program. size 0/1 = disabled (env
+    # override HYDRAGNN_POPULATION wins); the per-member lists are optional
+    # and must be length `size` when given (seeds default to range(size) —
+    # a deep ensemble wants distinct inits; learning_rates/weight_decays/
+    # task_weights default to the shared Optimizer/Architecture values).
+    pop_cfg = training.setdefault("population", {})
+    if not isinstance(pop_cfg, dict):
+        raise ValueError(
+            f"Training.population must be a dict, got {type(pop_cfg).__name__}"
+        )
+    pop_cfg.setdefault("size", 0)
+    pop_cfg.setdefault("seeds", None)
+    pop_cfg.setdefault("learning_rates", None)
+    pop_cfg.setdefault("weight_decays", None)
+    pop_cfg.setdefault("task_weights", None)
+    for _k in ("seeds", "learning_rates", "weight_decays", "task_weights"):
+        vals = pop_cfg[_k]
+        if vals is not None and len(vals) != int(pop_cfg["size"] or 0):
+            raise ValueError(
+                f"Training.population.{_k} has {len(vals)} entries for "
+                f"size={pop_cfg['size']}"
+            )
     # fault tolerance (hydragnn_tpu.resilience): non-finite step guard with
     # rollback escalation, preemption checkpointing, hung-dispatch watchdog
     res_cfg = training.setdefault("resilience", {})
@@ -268,6 +291,22 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     training.setdefault("precision", "fp32")
     training.setdefault("batch_size", 32)
     training.setdefault("Optimizer", {"type": "AdamW", "learning_rate": 1e-3})
+    # per-member weight decays need the decay INJECTED as a runtime
+    # hyperparameter, which select_optimizer only does for an explicit
+    # Optimizer.weight_decay (implicit decay stays a baked constant so the
+    # opt_state pytree — and every pre-existing checkpoint — keeps its
+    # historical structure): auto-fill the optax default when a population
+    # asks for per-member decays. Gated on the RESOLVED size (env wins):
+    # HYDRAGNN_POPULATION=0 must give the plain single-state run its
+    # historical pytree back, or disabling population mode would break the
+    # very checkpoint resume the explicit-only rule protects.
+    if pop_cfg.get("weight_decays") is not None:
+        from ..train.population import resolve_population_size
+
+        if resolve_population_size(training) > 1:
+            from ..train.optimizer import ensure_injected_weight_decay
+
+            ensure_injected_weight_decay(training["Optimizer"])
     voi.setdefault("denormalize_output", False)
 
     return config

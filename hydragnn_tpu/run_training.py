@@ -77,6 +77,92 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
     # model + optimizer (reference :97-121)
     model = create_model_config(config)
     optimizer = select_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+
+    # population training (train/population.py): N ensemble members / HPO
+    # trials vmapped into one jitted program — routed BEFORE the
+    # single-state init below (the population builds its own N-member
+    # state; initializing a throwaway single state first would waste one
+    # full init compile). The member axis IS the parallelism, so this
+    # route pins single-program mode (no data mesh / edge-sharding /
+    # pipeline; requesting both is a config error, not a silent downgrade)
+    # and returns the stacked PopulationState.
+    from .train.population import resolve_population_size, train_population
+
+    pop_n = resolve_population_size(config["NeuralNetwork"]["Training"])
+    if pop_n > 1:
+        arch_cfg = config["NeuralNetwork"].get("Architecture", {})
+        par_mode = str(arch_cfg.get("parallelism") or "data").lower()
+        if par_mode != "data" or arch_cfg.get("edge_sharding"):
+            raise ValueError(
+                f"Training.population.size={pop_n} cannot combine with "
+                f"Architecture.parallelism={par_mode!r}/edge_sharding — the "
+                "population member axis is the program's batch parallelism"
+            )
+        if world > 1:
+            # each process would train its own unsynchronized population on
+            # its loader shard and race on the same log dir — reject rather
+            # than silently produce world x N divergent model sets
+            raise ValueError(
+                f"Training.population.size={pop_n} is single-process for "
+                f"now, but this job runs {world} processes — launch one "
+                "process, or drop to per-process subprocess trials"
+            )
+        if training_cfg.get("continue"):
+            raise NotImplementedError(
+                "Training.continue with Training.population is not supported "
+                "yet: the checkpoint template is a single TrainState, not an "
+                "[N]-stacked population (restore a member via "
+                "train.population.member_state instead)"
+            )
+        from .utils.walltime import make_walltime_check
+
+        # same input-pipeline prefetch the single-state path wires below:
+        # collate (+ device_put at K=1; K>1 blocks stack host batches) runs
+        # ahead of the step loop — the population's per-dispatch work is N x
+        # heavier, but the host-side batch cost is identical and would
+        # otherwise sit on the critical path
+        depth = flags.get(
+            flags.PREFETCH, default=int(training_cfg.get("prefetch", 2))
+        )
+        pf_workers = flags.get(
+            flags.NUM_WORKERS, default=int(training_cfg.get("num_workers", 1))
+        )
+        if depth > 0:
+            from .graphs.batching import PrefetchLoader
+            from .train.superstep import resolve_steps_per_dispatch
+
+            k_pop = resolve_steps_per_dispatch(config["NeuralNetwork"]["Training"])
+            train_loader = PrefetchLoader(
+                train_loader, depth=depth, device_put=k_pop == 1,
+                workers=pf_workers,
+            )
+            val_loader = PrefetchLoader(
+                val_loader, depth=depth, device_put=True, workers=pf_workers
+            )
+            test_loader = PrefetchLoader(
+                test_loader, depth=depth, device_put=True, workers=pf_workers
+            )
+        pstate, summary = train_population(
+            model, optimizer, train_loader, val_loader, test_loader,
+            config["NeuralNetwork"], log_name, verbosity,
+            walltime_check=make_walltime_check(),
+        )
+        try:
+            from .train.checkpoint import save_checkpoint
+
+            # the stacked TrainState has the single-state treedef with [N]
+            # leaves, so the ordinary checkpoint machinery handles it;
+            # member_state(pstate, i) re-slices a winner for serving
+            save_checkpoint(
+                pstate.state, log_name,
+                epoch=int(config["NeuralNetwork"]["Training"].get("num_epoch", 0)),
+                meta={"final": True, "population": pop_n},
+            )
+        except Exception as e:
+            print_distributed(verbosity, f"final population save failed: {e}")
+        tr.print_timers(verbosity)
+        return pstate, model, config
+
     example = next(iter(train_loader))
     state = create_train_state(model, optimizer, example)
 

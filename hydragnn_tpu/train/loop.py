@@ -205,7 +205,7 @@ def _accumulate(step_metrics: list, extra_keys: tuple = ()):
 def train_epoch(
     train_step, state: TrainState, loader, verbosity: int = 0, mesh=None,
     put_fn=None, group_n=None, group_put=None, steps_per_dispatch: int = 1,
-    resilience=None, group_phys=None,
+    resilience=None, group_phys=None, accumulate=None,
 ):
     """One training epoch; returns (state, mean loss, per-task mean losses).
     ``put_fn`` (edge-sharded mode) transfers each batch itself — no device
@@ -218,6 +218,13 @@ def train_epoch(
     ``steps_per_dispatch`` (K>1): ``train_step`` must be the matching
     ``make_superstep(step, K)`` dispatch — each iteration consumes a
     ``[K(, n_dev), ...]`` block of K*n_dev loader batches.
+
+    ``accumulate`` overrides the epoch-metric reduction (default
+    ``_accumulate``). The population layer (``train/population.py``) passes a
+    member-axis-aware reducer here — its metrics carry a trailing ``[N]``
+    member axis ``_accumulate`` cannot tell apart from the superstep's
+    leading ``[K]`` — and then owns the skip/divergence reporting itself (the
+    default all-skipped NaN override only applies to the default reducer).
 
     ``resilience`` (a ``hydragnn_tpu.resilience.Resilience`` context) threads
     the fault-tolerance layer through the epoch: chaos fault injection and
@@ -317,14 +324,15 @@ def train_epoch(
     finally:
         tr.stop("train")
     has_skip = bool(step_metrics) and "skipped" in step_metrics[0]
-    loss, tasks, extras = _accumulate(
+    loss, tasks, extras = (accumulate or _accumulate)(
         step_metrics, extra_keys=("skipped", "num_graphs") if has_skip else ()
     )
     if has_skip:
         n_skipped = int(np.asarray(extras["skipped"]).sum())
         if res is not None:
             res.skipped_total += n_skipped
-        if n_skipped and float(np.asarray(extras["num_graphs"]).sum()) == 0.0:
+        if accumulate is None and n_skipped \
+                and float(np.asarray(extras["num_graphs"]).sum()) == 0.0:
             # EVERY real step was guard-skipped: the 0.0 that falls out of
             # the zero-weight accumulator is not a genuine loss — reporting
             # it would let the best-checkpoint logic pin best=0.0 forever
@@ -337,9 +345,12 @@ def train_epoch(
 
 def evaluate(
     eval_step, state: TrainState, loader, verbosity: int = 0, span: str = "validate",
-    mesh=None, put_fn=None, group_n=None, group_put=None,
+    mesh=None, put_fn=None, group_n=None, group_put=None, accumulate=None,
 ):
-    """Full-split evaluation; returns (loss, per-task losses, per-head rmse)."""
+    """Full-split evaluation; returns (loss, per-task losses, per-head rmse).
+    ``accumulate`` (see ``train_epoch``): a member-axis-aware reducer makes
+    this evaluate a whole vmapped population per dispatch — every return
+    value then carries a leading ``[N]`` member axis."""
     grouped, n_dev = _dispatch_layout(mesh, put_fn, group_n)
     it = (
         _grouped(loader, n_dev, mesh, fill=True, put=group_put)
@@ -358,7 +369,7 @@ def evaluate(
     if step_metrics:
         jax.block_until_ready(step_metrics[-1]["loss"])
     tr.stop(span)
-    loss, tasks, extras = _accumulate(
+    loss, tasks, extras = (accumulate or _accumulate)(
         step_metrics, extra_keys=("head_sse", "head_count")
     )
     sse, count = extras["head_sse"], extras["head_count"]

@@ -39,15 +39,70 @@ def _base_optimizer(opt_type: str, learning_rate: float) -> optax.GradientTransf
     raise NameError(f"The string used to identify the optimizer is NOT recognized: {opt_type}")
 
 
+# Optimizers with a decoupled weight-decay term: for these the decay is ALSO
+# injected as a runtime hyperparameter, so HPO trials / population members
+# differing only in weight decay share one compiled step program (the same
+# no-recompile contract the LR already has).
+_DECOUPLED_DECAY = {"adamw": optax.adamw, "lamb": optax.lamb, "fusedlamb": optax.lamb}
+
+
+def _optax_default_weight_decay(factory) -> float:
+    """The optimizer's own signature default (adamw: 1e-4, lamb: 0.0) — read
+    from optax rather than hardcoded, so an optax upgrade can't silently
+    fork our default from the library's."""
+    import inspect
+
+    return float(inspect.signature(factory).parameters["weight_decay"].default)
+
+
+def ensure_injected_weight_decay(optimizer_config: dict) -> dict:
+    """Make the decay injectable (what per-member population decays need):
+    fill an explicit ``weight_decay`` — the optax factory's own signature
+    default — when the config leaves it implicit, so ``select_optimizer``
+    builds the injected-hyperparameter chain. Raises for optimizers without
+    a decoupled-decay term. Mutates and returns ``optimizer_config``; the
+    ONE implementation behind ``config.update_config`` (the
+    ``Training.population.weight_decays`` route) and
+    ``make_population_objective`` (the HPO vmap route)."""
+    if optimizer_config.get("weight_decay") is None:
+        factory = _DECOUPLED_DECAY.get(
+            str(optimizer_config.get("type", "AdamW")).lower()
+        )
+        if factory is None:
+            raise ValueError(
+                "per-member weight decays require a decoupled-decay "
+                f"optimizer (one of {sorted(_DECOUPLED_DECAY)}), got "
+                f"{optimizer_config.get('type')!r}"
+            )
+        optimizer_config["weight_decay"] = _optax_default_weight_decay(factory)
+    return optimizer_config
+
+
 def select_optimizer(optimizer_config: dict) -> optax.GradientTransformation:
     """Build an optax optimizer from the ``Training.Optimizer`` config section.
 
     The learning rate is injected as a runtime hyperparameter:
     ``opt_state.hyperparams["learning_rate"]`` can be overwritten on host
-    between steps (how ReduceLROnPlateau applies its decay).
-    """
+    between steps (how ReduceLROnPlateau applies its decay). For decoupled-
+    decay optimizers (AdamW/LAMB) an EXPLICIT ``Training.Optimizer.
+    weight_decay`` is injected the same way (``hyperparams["weight_decay"]``)
+    — what lets a vmapped population carry per-member decays in the stacked
+    optimizer state. Absent the key, the optax default applies as a baked
+    constant and the opt_state pytree keeps its historical structure, so
+    checkpoints from before weight-decay injection still restore (the
+    population config path auto-fills the key when per-member decays are
+    requested — ``config.update_config``)."""
     lr = float(optimizer_config["learning_rate"])
     opt_type = optimizer_config.get("type", "AdamW")
+    factory = _DECOUPLED_DECAY.get(opt_type.lower())
+    wd = optimizer_config.get("weight_decay")
+    if factory is not None and wd is not None:
+
+        @optax.inject_hyperparams
+        def make_decoupled(learning_rate, weight_decay):
+            return factory(learning_rate, weight_decay=weight_decay)
+
+        return make_decoupled(learning_rate=lr, weight_decay=float(wd))
 
     @optax.inject_hyperparams
     def make(learning_rate):
@@ -56,20 +111,38 @@ def select_optimizer(optimizer_config: dict) -> optax.GradientTransformation:
     return make(learning_rate=lr)
 
 
-def set_learning_rate(opt_state, lr: float):
-    """Overwrite the injected LR in an optimizer state (returns new state).
+def set_hyperparam(opt_state, name: str, value: float):
+    """Overwrite one injected hyperparameter in an optimizer state (returns
+    new state).
 
     The new value mirrors the old leaf's dtype/weak-type exactly: a plain
     Python float here would change the jit cache key of the train step
-    (strong f32 array -> weak float) and force one retrace per LR decay —
+    (strong f32 array -> weak float) and force one retrace per update —
     breaking the no-recompile promise in the module docstring (and tripping
     HYDRAGNN_COMPILE_SENTINEL on perfectly healthy runs)."""
     import jax.numpy as jnp
 
     hp = dict(opt_state.hyperparams)
-    old = hp["learning_rate"]
-    hp["learning_rate"] = jnp.asarray(lr, dtype=getattr(old, "dtype", jnp.float32))
+    if name not in hp:
+        raise KeyError(
+            f"optimizer state has no injected hyperparameter {name!r} "
+            f"(available: {sorted(hp)}); weight_decay is only injected for "
+            f"decoupled-decay optimizers ({sorted(_DECOUPLED_DECAY)}) with an "
+            "explicit Training.Optimizer.weight_decay value"
+        )
+    old = hp[name]
+    hp[name] = jnp.asarray(value, dtype=getattr(old, "dtype", jnp.float32))
     return opt_state._replace(hyperparams=hp)
+
+
+def get_hyperparam(opt_state, name: str) -> float:
+    return float(opt_state.hyperparams[name])
+
+
+def set_learning_rate(opt_state, lr: float):
+    """Overwrite the injected LR in an optimizer state (returns new state);
+    see :func:`set_hyperparam` for the dtype discipline."""
+    return set_hyperparam(opt_state, "learning_rate", lr)
 
 
 def get_learning_rate(opt_state) -> float:

@@ -118,11 +118,17 @@ def donate_state_argnums() -> tuple:
         return ()
 
 
-def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
-    """Build the jitted single-device train step:
-    (state, batch) -> (state, metrics dict)."""
+def _make_step_impl(model: HydraModel, optimizer, compute_dtype):
+    """The shared (unjitted) train-step body behind :func:`make_train_step`
+    and :func:`make_weighted_train_step`. ``task_weights=None`` is the
+    static path — byte-for-byte the historical step program (total loss from
+    ``model.loss``'s baked-in ``spec.task_weights``). A traced ``[n_tasks]``
+    ``task_weights`` re-weights the SAME per-task losses in the SAME
+    accumulation order, so a traced vector equal to the spec weights is
+    bit-identical to the static path — the contract the population layer's
+    per-member loss weights rely on."""
 
-    def loss_fn(params, batch_stats, batch: GraphBatch, dropout_rng):
+    def loss_fn(params, batch_stats, batch: GraphBatch, dropout_rng, task_weights):
         c_params = _cast_floats(params, compute_dtype)
         c_batch = _cast_floats(batch, compute_dtype)
 
@@ -151,13 +157,18 @@ def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
             outputs, updates = apply_train(c_batch, dropout_rng)
         pred = _cast_floats(outputs, jnp.float32)
         tot, tasks = model.loss(pred, batch)
+        if task_weights is not None:
+            # same accumulation order as model.loss; the statically-weighted
+            # `tot` above is dead code XLA eliminates
+            tot = 0.0
+            for ihead, task_loss in enumerate(tasks):
+                tot = tot + task_loss * task_weights[ihead]
         return tot, (tasks, updates["batch_stats"])
 
-    @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
-    def train_step(state: TrainState, batch: GraphBatch):
+    def step_impl(state: TrainState, batch: GraphBatch, task_weights):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
         (tot, (tasks, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, state.batch_stats, batch, dropout_rng
+            state.params, state.batch_stats, batch, dropout_rng, task_weights
         )
         grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
@@ -174,6 +185,37 @@ def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
             "num_graphs": batch.graph_mask.sum(),
         }
         return new_state, metrics
+
+    return step_impl
+
+
+def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
+    """Build the jitted single-device train step:
+    (state, batch) -> (state, metrics dict)."""
+    step_impl = _make_step_impl(model, optimizer, compute_dtype)
+
+    @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
+    def train_step(state: TrainState, batch: GraphBatch):
+        return step_impl(state, batch, None)
+
+    return train_step
+
+
+def make_weighted_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
+    """Like :func:`make_train_step` but with TRACED task weights:
+    ``(state, batch, task_weights[n_tasks]) -> (state, metrics)``.
+
+    The weights ride the program as data, not constants, so N differently
+    weighted trainings share one executable — the population layer vmaps this
+    step with a per-member ``[N, n_tasks]`` weight stack (HPO over loss
+    weights / heteroscedastic ensembles) without N recompiles. Callers pass
+    weights normalized the way ``ModelSpec`` normalizes ``task_weights``
+    (w / sum|w|) if they want parity with a statically-weighted run."""
+    step_impl = _make_step_impl(model, optimizer, compute_dtype)
+
+    @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
+    def train_step(state: TrainState, batch: GraphBatch, task_weights):
+        return step_impl(state, batch, task_weights)
 
     return train_step
 

@@ -62,16 +62,29 @@ _NO_CONSTRAINT = object()
 
 
 def select_state(keep, new_state, old_state):
-    """Branchless pytree select: ``new_state`` where the scalar bool ``keep``
-    holds, else ``old_state`` — ONE fused compare+select inside the step
-    program, no extra dispatch, no retrace. The shared skip primitive of the
-    superstep's fill-batch skip and the resilience layer's non-finite step
-    guard (``resilience/guard.py``); both must revert EVERY leaf (params,
-    batch stats, optimizer moments, step counter) or AdamW decay / the
-    dropout rng fold drift on skipped steps."""
-    return jax.tree.map(
-        lambda n, o: jnp.where(keep, n, o), new_state, old_state
-    )
+    """Branchless pytree select: ``new_state`` where the bool ``keep`` holds,
+    else ``old_state`` — ONE fused compare+select inside the step program, no
+    extra dispatch, no retrace. The shared skip primitive of the superstep's
+    fill-batch skip, the resilience layer's non-finite step guard
+    (``resilience/guard.py``), and the population layer's per-member
+    divergence skip (``train/population.py``); all must revert EVERY leaf
+    (params, batch stats, optimizer moments, step counter) or AdamW decay /
+    the dropout rng fold drift on skipped steps.
+
+    ``keep`` may be a scalar (whole-state skip) or a ``[N]`` member mask
+    (population state, every leaf ``[N, ...]``): a non-scalar ``keep``
+    broadcasts against each leaf's LEADING axes, so member ``i`` keeps or
+    reverts independently. (A bare ``jnp.where`` would broadcast against the
+    TRAILING axes and pair members with feature columns.)"""
+    keep = jnp.asarray(keep)
+
+    def sel(n, o):
+        k = keep
+        if keep.ndim and jnp.ndim(n) > keep.ndim:
+            k = keep.reshape(keep.shape + (1,) * (jnp.ndim(n) - keep.ndim))
+        return jnp.where(k, n, o)
+
+    return jax.tree.map(sel, new_state, old_state)
 
 
 def state_shardings(state):

@@ -56,6 +56,17 @@ SUPERSTEP = _register(Flag(
     "to 2 more staged ahead (~3K batches) and coarser (K-step) metric "
     "granularity. Edge-sharded and pipeline modes pin K=1 (their "
     "per-batch placement has no stacked [K, ...] equivalent yet)."))
+POPULATION = _register(Flag(
+    "HYDRAGNN_POPULATION", "int", None,
+    "Train N population members (HPO trials / deep-ensemble replicas) as "
+    "ONE jitted program by vmapping the train step over a leading member "
+    "axis (train/population.py; overrides Training.population.size, "
+    "unset/0/1 disables). Composes with HYDRAGNN_SUPERSTEP: one dispatch "
+    "advances N members x K steps. Members share the batch stream and "
+    "differ in init seed, lr, weight decay, and loss weights (runtime data, "
+    "not compile-time constants); a NaN/Inf member is select-skipped in "
+    "program and reported 'diverged' without stalling the rest. Pins "
+    "single-program mode: no data mesh, edge-sharding, or pipeline."))
 NONFINITE_GUARD = _register(Flag(
     "HYDRAGNN_NONFINITE_GUARD", "bool", None,
     "Force the non-finite step guard on/off (overrides "
