@@ -715,6 +715,116 @@ def bench_population_ab(batch_size: int = 64, bench_steps: int = 24,
     }
 
 
+def bench_serving_ab(batch_size: int = 32, n_requests: int = 160,
+                     windows: int = 4, flush_ms: float = 3.0) -> dict:
+    """Serving A/B (ISSUE 9): per-request dispatch (flush 0 ms, one graph per
+    batch — the no-batching server every naive deployment starts as) vs
+    dynamic bucketed micro-batching, both endpoints of ONE warm
+    ``PredictionServer`` (which also exercises multi-model routing in the
+    bench itself). CPU-provable columns: warm-up compile seconds + per-arm
+    steady-state lowering deltas (ZERO for both — the strict-sentinel
+    property), pooled client p50/p99 latency, graphs/sec, and ABBA
+    paired-window wall clock with the shared ``_abba_verdict`` at budget 0
+    ('pass' = the micro-batched arm clears the noise floor)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.analysis.sentinel import compile_counts
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.serve import PredictionServer, ServingConfig, run_traffic
+    from hydragnn_tpu.train import create_train_state, select_optimizer
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 64
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    samples = make_qm9_like_samples(max(batch_size * 4, 256), seed=41)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    example = next(iter(GraphLoader(samples, batch_size)))
+    state = create_train_state(
+        model, optimizer, jax.tree.map(jnp.asarray, example)
+    )
+
+    server = PredictionServer(ServingConfig(queue_depth=max(512, n_requests)))
+    server.add_model("per_request", model, state, cfg, samples=samples,
+                     batch_size=batch_size, flush_ms=0.0, max_batch_graphs=1)
+    server.add_model("batched", model, state, cfg, samples=samples,
+                     batch_size=batch_size, flush_ms=flush_ms)
+    c0 = compile_counts()["lowerings"]
+    t0 = time.perf_counter()
+    warm_report = server.warmup(verify=True)
+    compiles_warmup = compile_counts()["lowerings"] - c0
+    warmup_s = time.perf_counter() - t0
+    server.start()
+    try:
+        # untimed burn-in pair (allocator/cache settle, matches the other
+        # ABBA rows), then alternate arm order window to window
+        run_traffic(server, "per_request", samples, n_requests // 2, seed=1)
+        run_traffic(server, "batched", samples, n_requests // 2, seed=1)
+        a_ms, b_ms = [], []
+        a_lat, b_lat = [], []
+        compiles = {"per_request": 0, "batched": 0}
+
+        def run_arm(arm, seed):
+            s0 = compile_counts()["lowerings"]
+            rep = run_traffic(server, arm, samples, n_requests, seed=seed)
+            compiles[arm] += compile_counts()["lowerings"] - s0
+            return rep
+
+        for w in range(max(windows, 1)):
+            if w % 2 == 0:
+                ra = run_arm("per_request", seed=w)
+                rb = run_arm("batched", seed=w)
+            else:
+                rb = run_arm("batched", seed=w)
+                ra = run_arm("per_request", seed=w)
+            a_ms.append(1e3 * ra.wall_s)
+            b_ms.append(1e3 * rb.wall_s)
+            a_lat.extend(ra.latencies_s)
+            b_lat.extend(rb.latencies_s)
+        stats = server.stats()
+    finally:
+        server.stop()
+    overhead_pct, noise_pct, verdict = _abba_verdict(a_ms, b_ms, budget_pct=0.0)
+    pct = lambda xs, q: round(1e3 * float(np.percentile(xs, q)), 3)
+    return {
+        "workload": "serving_ab",
+        "n_requests_per_window": n_requests,
+        "flush_ms": flush_ms,
+        "warmup_s": round(warmup_s, 3),
+        "warmup_report": warm_report,
+        "compiles_warmup": compiles_warmup,
+        # steady-state lowering deltas per arm: the zero-recompile guarantee
+        "compiles_steady_per_request": compiles["per_request"],
+        "compiles_steady_batched": compiles["batched"],
+        "p50_ms_per_request": pct(a_lat, 50),
+        "p99_ms_per_request": pct(a_lat, 99),
+        "p50_ms_batched": pct(b_lat, 50),
+        "p99_ms_batched": pct(b_lat, 99),
+        "graphs_per_sec_per_request": round(
+            n_requests / (statistics.median(a_ms) / 1e3), 1
+        ),
+        "graphs_per_sec_batched": round(
+            n_requests / (statistics.median(b_ms) / 1e3), 1
+        ),
+        "window_ms_per_request": [round(x, 2) for x in a_ms],
+        "window_ms_batched": [round(x, 2) for x in b_ms],
+        "batch_occupancy": stats["batched"]["occupancy"],
+        "serving_speedup": round(
+            statistics.median(a_ms) / statistics.median(b_ms), 4
+        ),
+        # _abba_verdict measures B-vs-A overhead; negative = batching wins
+        "batched_overhead_pct": round(overhead_pct, 2),
+        "noise_pct": round(noise_pct, 2),
+        "verdict": verdict,
+        "batch_size": batch_size,
+    }
+
+
 def _iqr(xs):
     s = sorted(xs)
     if len(s) < 4:  # too few windows for quartiles: full range (>= 0)
@@ -1001,6 +1111,7 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
     ab = bench_superstep_ab(batch_size, max(steps, k), warmup, k=k)
     guard = bench_resilience_overhead(batch_size, max(steps, 10), warmup)
     pop = bench_population_ab(batch_size, max(steps, k), warmup, k=k)
+    serving = bench_serving_ab(batch_size=min(batch_size, 32), n_requests=96)
     return {
         "workload": "cpu_smoke",
         "degraded": True,
@@ -1011,6 +1122,7 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
         "superstep_ab": ab,
         "resilience_overhead": guard,
         "population_ab": pop,
+        "serving_ab": serving,
     }
 
 
@@ -1554,6 +1666,10 @@ def child_main(status_path: str) -> None:
         ("population_ab",
          lambda: bench_population_ab(batch_size, bench_steps, warmup))
     )
+    # ISSUE 9 acceptance row: per-request vs bucketed micro-batched serving
+    # through one warm PredictionServer (p50/p99, graphs/sec, per-arm
+    # steady-state compile counts — zero after AOT warm-up)
+    plan.append(("serving_ab", lambda: bench_serving_ab()))
     if os.getenv("BENCH_FUSED_AUTOTUNE", "1") != "0":
         # cheap kernel-only sweep BEFORE the compile-heavy arch entries, so
         # a short window still yields the tuning data it was added for

@@ -139,6 +139,29 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     for key, val in store_config_defaults().items():
         store_cfg.setdefault(key, val)
 
+    # serving tier (hydragnn_tpu.serve): the top-level Serving block's
+    # defaults ARE the ServingConfig dataclass field defaults (same
+    # single-source pattern as Dataset.store above); HYDRAGNN_SERVE_* env
+    # flags override at server construction. Validated here so a typo'd
+    # serving deployment fails at config load, not at first request.
+    serving_cfg = config.setdefault("Serving", {})
+    if not isinstance(serving_cfg, dict):
+        raise ValueError(
+            f"Serving must be a dict, got {type(serving_cfg).__name__}"
+        )
+    from ..serve.server import ServingConfig, serving_config_defaults
+
+    serving_defaults = serving_config_defaults()
+    unknown = set(serving_cfg) - set(serving_defaults)
+    if unknown:
+        raise ValueError(
+            f"Unknown Serving key(s) {sorted(unknown)}; known: "
+            f"{sorted(serving_defaults)}"
+        )
+    for key, val in serving_defaults.items():
+        serving_cfg.setdefault(key, val)
+    ServingConfig(**serving_cfg).validate()  # one range-check implementation
+
     # --- GPS / encoding defaults (reference :40-48) ---
     arch.setdefault("global_attn_engine", None)
     arch.setdefault("global_attn_type", None)

@@ -111,10 +111,16 @@ def compute_pad_spec(
     )
 
 
-def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
+def collate(samples: Sequence[GraphSample], pad: PadSpec,
+            certify: bool = True) -> GraphBatch:
     """Concatenate ``samples`` and pad to ``pad``. Raises if the bucket is too
     small — padding must be sized by ``compute_pad_spec`` (or the config's
-    bucket table), never silently truncated."""
+    bucket table), never silently truncated.
+
+    ``certify=False`` skips the ``_batch_meta`` kernel-layout certification
+    (four O(E) host scans) and sets ``meta=None`` — for callers that replace
+    the meta anyway (the serving tier pins one canonical meta per bucket, so
+    paying certification per micro-batch would be pure hot-path waste)."""
     n_graphs = len(samples)
     if n_graphs > pad.n_graph - 1:
         raise ValueError(f"{n_graphs} graphs exceed bucket capacity {pad.n_graph - 1}")
@@ -222,7 +228,7 @@ def collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
         idx_kj=idx_kj, idx_ji=idx_ji, triplet_mask=triplet_mask,
         pe=pe, rel_pe=rel_pe, z=z,
         meta=_batch_meta(senders, receivers, batch, n_node, N, G, pad.node_cap,
-                         getattr(pad, "attn_cap", 0)),
+                         getattr(pad, "attn_cap", 0)) if certify else None,
     )
 
 
@@ -337,6 +343,33 @@ def compute_pad_buckets(
     return buckets
 
 
+def pick_bucket(
+    buckets: Sequence[PadSpec],
+    tot_node: int,
+    tot_edge: int,
+    tot_triplet: int = 0,
+    n_graphs: int = 0,
+) -> PadSpec | None:
+    """Smallest bucket of an ascending table that fits the given batch totals
+    (strictly fewer nodes than slots — ``collate`` reserves the last node as
+    the padding sink; ``n_graphs`` real graphs need ``n_graph - 1`` slots,
+    which matters for caller-supplied tables with non-uniform graph
+    capacity). Returns ``None`` when even the largest bucket cannot hold the
+    batch, so callers choose their own policy: ``GraphLoader`` falls through
+    to the top bucket (collate raises if it truly overflows), the serving
+    micro-batcher treats ``None`` as "flush before adding" / "reject an
+    oversize request"."""
+    for b in buckets:
+        if (
+            tot_node < b.n_node
+            and tot_edge <= b.n_edge
+            and tot_triplet <= b.n_triplet
+            and n_graphs <= b.n_graph - 1
+        ):
+            return b
+    return None
+
+
 class GraphLoader:
     """Minimal host-side dataloader: shuffles, batches, collates to a bucket.
 
@@ -419,10 +452,7 @@ class GraphLoader:
         self.block = max(1, int(k))
 
     def _pick_bucket_totals(self, tot_n: int, tot_e: int, tot_t: int) -> PadSpec:
-        for b in self.buckets:
-            if tot_n < b.n_node and tot_e <= b.n_edge and tot_t <= b.n_triplet:
-                return b
-        return self.buckets[-1]
+        return pick_bucket(self.buckets, tot_n, tot_e, tot_t) or self.buckets[-1]
 
     def _pick_bucket(self, chunk: Sequence[GraphSample]) -> PadSpec:
         if not self.buckets:

@@ -7,21 +7,22 @@ from __future__ import annotations
 import numpy as np
 
 
-def output_denormalize(voi: dict, true_values, predicted_values, spec):
-    """``y = y_norm * (max - min) + min`` per head (reference
-    ``postprocess.py:13-54``). ``voi`` carries ``minmax_graph_feature`` /
-    ``minmax_node_feature`` as [2, F] arrays and ``output_index``/``type``."""
+def head_scales(voi: dict, spec) -> list:
+    """Per-head ``(lo, rng)`` denormalization scales from the minmax tables
+    the data pipeline recorded. ``voi`` carries ``minmax_graph_feature`` /
+    ``minmax_node_feature`` as [2, F] arrays; node minmax columns are
+    [input features..., node targets...] — targets start after the inputs
+    (see preprocess.normalize_features). Shared by the paired evaluator
+    denormalize below and the serving tier's preds-only path."""
     node_minmax = np.asarray(voi.get("minmax_node_feature", []))
     graph_minmax = np.asarray(voi.get("minmax_graph_feature", []))
-    # node minmax columns are [input features..., node targets...] — targets
-    # start after the inputs (see preprocess.normalize_features)
     node_target_dims = sum(
         d for d, t in zip(spec.output_dim, spec.output_type) if t == "node"
     )
     x_dim = node_minmax.shape[1] - node_target_dims if node_minmax.size else 0
-    out_t, out_p = [], []
+    scales = []
     g_off = n_off = 0
-    for ihead, (otype, dim) in enumerate(zip(spec.output_type, spec.output_dim)):
+    for otype, dim in zip(spec.output_type, spec.output_dim):
         if otype == "graph" and graph_minmax.size:
             lo = graph_minmax[0, g_off : g_off + dim]
             hi = graph_minmax[1, g_off : g_off + dim]
@@ -32,7 +33,20 @@ def output_denormalize(voi: dict, true_values, predicted_values, spec):
             n_off += dim
         else:
             lo, hi = 0.0, 1.0
-        rng = np.where(np.asarray(hi) - np.asarray(lo) < 1e-12, 1.0, np.asarray(hi) - np.asarray(lo))
+        rng = np.where(
+            np.asarray(hi) - np.asarray(lo) < 1e-12,
+            1.0,
+            np.asarray(hi) - np.asarray(lo),
+        )
+        scales.append((lo, rng))
+    return scales
+
+
+def output_denormalize(voi: dict, true_values, predicted_values, spec):
+    """``y = y_norm * (max - min) + min`` per head (reference
+    ``postprocess.py:13-54``)."""
+    out_t, out_p = [], []
+    for ihead, (lo, rng) in enumerate(head_scales(voi, spec)):
         out_t.append(true_values[ihead] * rng + lo)
         out_p.append(predicted_values[ihead] * rng + lo)
     return out_t, out_p

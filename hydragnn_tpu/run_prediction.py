@@ -2,6 +2,12 @@
 ``hydragnn/run_prediction.py:34-114``): same data prologue, then runs the test
 split and returns ``(error, per-task losses, true values, predictions)`` with
 optional min-max denormalization (reference ``postprocess/postprocess.py:13``).
+
+The predict path itself (step construction, per-head gather, denormalize)
+lives in ``serve.predictor.Predictor`` — shared with the always-hot serving
+tier so the batch evaluator and the server execute identical code; this
+module is the thin batch driver around it (data prologue, epoch loop,
+cross-rank gather, loss reduction).
 """
 
 from __future__ import annotations
@@ -13,10 +19,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import load_config, update_config
-from .models.base import head_columns
 from .models.create import create_model_config
 from .preprocess.load_data import dataset_loading_and_splitting
-from .train.step import TrainState, make_predict_step, resolve_precision
+from .serve.predictor import Predictor
+from .train.step import TrainState
 
 
 def _allgather_ragged(arr: np.ndarray) -> np.ndarray:
@@ -52,32 +58,20 @@ def run_prediction(config_source, state: TrainState, model=None, samples: Sequen
     if model is None:
         model = create_model_config(config)
 
-    precision = resolve_precision(
-        config["NeuralNetwork"]["Training"].get("precision", "fp32")
-    )
-    predict_step = make_predict_step(model, compute_dtype=precision)
+    predictor = Predictor(model, state, config)
 
     # ONE pass over the test split: gather per-head true/pred arrays
     # (reference ``test()`` collection + gather,
     # train_validate_test.py:989-1080); loss/RMSE are computed from the
     # gathered arrays below instead of a second forward pass.
-    cols = head_columns(model.spec)
-    trues = [[] for _ in cols]
-    preds = [[] for _ in cols]
+    trues = [[] for _ in predictor.cols]
+    preds = [[] for _ in predictor.cols]
     for batch in test_loader:
         batch = jax.tree.map(jnp.asarray, batch)
-        out = predict_step(state, batch)
-        if model.spec.var_output:
-            out = out[0]
-        for ihead, (kind, col, dim) in enumerate(cols):
-            if kind == "graph":
-                mask = np.asarray(batch.graph_mask) > 0
-                trues[ihead].append(np.asarray(batch.graph_y[:, col : col + dim])[mask])
-                preds[ihead].append(np.asarray(out[ihead])[mask])
-            else:
-                mask = np.asarray(batch.node_mask) > 0
-                trues[ihead].append(np.asarray(batch.node_y[:, col : col + dim])[mask])
-                preds[ihead].append(np.asarray(out[ihead])[mask])
+        bt, bp = predictor.gather(batch)
+        for ihead in range(len(predictor.cols)):
+            trues[ihead].append(bt[ihead])
+            preds[ihead].append(bp[ihead])
     true_values = [np.concatenate(t) for t in trues]
     predicted_values = [np.concatenate(p) for p in preds]
     if world > 1:
@@ -101,13 +95,9 @@ def run_prediction(config_source, state: TrainState, model=None, samples: Sequen
     ]
     error = float(sum(w * l for w, l in zip(spec.task_weights, tasks_loss)))
 
-    voi = config["NeuralNetwork"]["Variables_of_interest"]
-    if voi.get("denormalize_output"):
-        from .postprocess.postprocess import output_denormalize
-
-        true_values, predicted_values = output_denormalize(
-            voi, true_values, predicted_values, model.spec
-        )
+    true_values, predicted_values = predictor.denormalize(
+        true_values, predicted_values
+    )
 
     return error, tasks_loss, true_values, predicted_values
 

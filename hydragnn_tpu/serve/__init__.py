@@ -1,0 +1,52 @@
+"""Always-hot serving tier (ISSUE 9 / ROADMAP "[serving]").
+
+A persistent prediction server over the training stack's own primitives:
+``graphs.batching`` pad buckets for dynamic micro-batching,
+``utils.compile_cache`` AOT compilation for boot-time warm-up,
+``analysis.sentinel`` for the zero-recompile steady-state guarantee, and the
+shared :class:`~hydragnn_tpu.serve.predictor.Predictor` core so served
+answers bit-match ``run_prediction`` on identical fp32 inputs.
+"""
+
+from .admission import (  # noqa: F401
+    AdmissionError,
+    DeadlineExceededError,
+    IncompatibleSampleError,
+    OversizeError,
+    QueueFullError,
+    Request,
+    RequestQueue,
+    ServerClosedError,
+    UnknownModelError,
+)
+from .batcher import MicroBatcher, canonical_meta, serving_collate  # noqa: F401
+from .predictor import Predictor  # noqa: F401
+from .server import (  # noqa: F401
+    ModelEndpoint,
+    PredictionServer,
+    ServingConfig,
+    serving_config_defaults,
+)
+from .traffic import TrafficReport, run_traffic  # noqa: F401
+
+__all__ = [
+    "AdmissionError",
+    "DeadlineExceededError",
+    "IncompatibleSampleError",
+    "MicroBatcher",
+    "ModelEndpoint",
+    "OversizeError",
+    "PredictionServer",
+    "Predictor",
+    "QueueFullError",
+    "Request",
+    "RequestQueue",
+    "ServerClosedError",
+    "ServingConfig",
+    "TrafficReport",
+    "UnknownModelError",
+    "canonical_meta",
+    "run_traffic",
+    "serving_collate",
+    "serving_config_defaults",
+]

@@ -1,0 +1,210 @@
+"""Dynamic bucketed micro-batching for the serving tier.
+
+Coalesces in-flight requests into the tightest ``PadSpec`` bucket of the
+endpoint's table (the SAME table ``graphs.batching`` derives for training —
+one padding scheme, one compile budget) under a max-latency flush timer:
+the first request of a batch opens a flush window of ``flush_ms``; requests
+arriving inside the window join until the batch would overflow the TOP
+bucket (or hit the graph-slot cap), then the batch dispatches.
+
+Treedef pinning: training-time ``collate`` certifies per-batch kernel-layout
+guarantees into ``BatchMeta`` — static aux data that KEYS the jit cache. A
+server fed arbitrary request mixes would flip those bits batch-to-batch and
+recompile in steady state, so :func:`serving_collate` pins every batch of a
+bucket to one canonical conservative meta (all kernel certs ``False``, one
+stable attention bound): every batch of a bucket shares one treedef and the
+warm executable table stays complete forever. The cost is that serving always
+takes the certified-fallback kernel paths — irrelevant on CPU (the fused
+kernels are TPU-only) and a deliberate latency-jitter-vs-peak-throughput
+trade on TPU.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+from ..graphs.batching import PadSpec, collate, pick_bucket
+from ..graphs.graph import BatchMeta, GraphBatch, GraphSample
+from .admission import (
+    DeadlineExceededError,
+    OversizeError,
+    Request,
+    RequestQueue,
+)
+
+
+def canonical_meta(pad: PadSpec) -> BatchMeta:
+    """The ONE ``BatchMeta`` every served batch of ``pad`` carries.
+
+    Kernel certs pinned ``False`` (conservative: fallback paths are always
+    sound). ``max_n_node`` pinned to the bucket's dataset-wide per-graph cap
+    when known, else the power-of-two ceiling of the bucket's node slots —
+    constant, so GPS dense-vs-flat attention resolves once per bucket at
+    warm-up. The bound is only sound for graphs the batcher ADMITS: a graph
+    with more nodes than ``max_n_node`` would be certified under a false
+    bound (GPS dense blocks would silently truncate it), so the micro-batcher
+    sheds such requests as ``OversizeError`` — outside the size envelope the
+    endpoint's programs were certified for."""
+    if pad.node_cap:
+        # a user attn_cap below node_cap is deliberately NOT used here:
+        # serving pins ONE cert level per bucket (no per-batch outlier
+        # fallback), and only node_cap covers every admissible graph
+        bound = pad.node_cap
+    else:
+        bound = max(1 << max(pad.n_node - 1, 0).bit_length(), 8)
+    return BatchMeta(
+        gs_fits=False, recv_fits=False, send_fits=False, pool_fits=False,
+        max_n_node=int(bound),
+    )
+
+
+def serving_collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:
+    """``graphs.batching.collate`` + the bucket's canonical meta — the only
+    collate the serving tier runs, so every batch of a bucket shares one
+    treedef (zero steady-state recompiles by construction)."""
+    return collate(samples, pad, certify=False)._replace(
+        meta=canonical_meta(pad)
+    )
+
+
+# how long before a member's deadline the coalescing window closes, so the
+# batch DISPATCHES (and passes the dispatch-time expiry re-check) in time
+_DISPATCH_MARGIN_S = 0.002
+
+
+def _totals(sample: GraphSample) -> tuple[int, int, int]:
+    t = sample.extras["idx_kj"].shape[0] if "idx_kj" in sample.extras else 0
+    return sample.num_nodes, sample.num_edges, t
+
+
+class MicroBatcher:
+    """Forms (requests, bucket) batches from a :class:`RequestQueue`.
+
+    One instance per endpoint, consumed by that endpoint's dispatcher
+    thread. Policy, in order, for each batch:
+
+    1. Block for the first live request (expired ones fail fast with
+       :class:`DeadlineExceededError` — serving a dead request wastes the
+       bucket slot AND delays live ones behind it).
+    2. A request that alone overflows the TOP bucket is shed with
+       :class:`OversizeError` — waiting cannot make it fit.
+    3. Keep admitting requests until the flush window closes, the batch
+       holds ``max_graphs`` requests, or the next request would overflow the
+       top bucket (it goes back to the queue HEAD for the next batch).
+    4. Collate target: the TIGHTEST table bucket that fits the accumulated
+       totals.
+    """
+
+    def __init__(self, queue: RequestQueue, buckets: Sequence[PadSpec],
+                 flush_s: float, max_graphs: int = 0, on_shed=None):
+        self.queue = queue
+        self.buckets = sorted(buckets, key=lambda p: p.as_tuple())
+        self.flush_s = max(0.0, float(flush_s))
+        # graph-slot capacity differs per bucket for caller-supplied tables;
+        # the per-bucket check lives in pick_bucket (n_graphs), this cap only
+        # bounds coalescing at the largest capacity in the table
+        cap = max(b.n_graph - 1 for b in self.buckets)
+        self.max_graphs = min(int(max_graphs), cap) if max_graphs > 0 else cap
+        # per-bucket certified node bound (canonical_meta.max_n_node): a
+        # batch may only collate to a bucket whose bound covers its LARGEST
+        # member, or GPS dense-block attention would silently truncate it.
+        # node_bound (the max) is the admission envelope: above it no bucket
+        # can certify the graph at all.
+        self._bounds = {
+            b.as_tuple(): canonical_meta(b).max_n_node for b in self.buckets
+        }
+        self.node_bound = max(self._bounds.values())
+        # on_shed(kind): endpoint counter hook — batcher-side sheds
+        # ("deadline", "oversize") must show up in stats() like
+        # admission-side ones, or submitted != served + shed + failed
+        self.on_shed = on_shed or (lambda kind: None)
+
+    def _pick(self, tot_n: int, tot_e: int, tot_t: int, n_graphs: int,
+              max_member_n: int) -> "PadSpec | None":
+        """Tightest bucket that fits the totals AND certifies the largest
+        member graph — both conditions, or the batch is unservable there."""
+        certifying = [
+            b for b in self.buckets
+            if self._bounds[b.as_tuple()] >= max_member_n
+        ]
+        return pick_bucket(certifying, tot_n, tot_e, tot_t, n_graphs)
+
+    def _admissible(self, req: Request) -> bool:
+        """Shed-or-keep gate shared by the batch opener and the coalescing
+        loop: expired requests and requests that fit/certify in NO bucket
+        even alone are rejected typed (counted "cancelled" when the client's
+        own cancel won the race); True means the request is servable."""
+        if req.expired():
+            kind = "deadline" if req.reject(DeadlineExceededError(
+                "deadline passed while queued"
+            )) else "cancelled"
+            self.on_shed(kind)
+            return False
+        n, e, t = _totals(req.sample)
+        if self._pick(n, e, t, 1, n) is None:
+            kind = "oversize" if req.reject(OversizeError(
+                f"sample ({n} nodes, {e} edges, {t} triplets) fits no "
+                f"serving bucket of this endpoint (largest "
+                f"{self.buckets[-1]!r}, certified per-graph node bound "
+                f"{self.node_bound}) — outside the envelope its programs "
+                "were certified for"
+            )) else "cancelled"
+            self.on_shed(kind)
+            return False
+        return True
+
+    def _first_live(self, block: bool) -> Request | None:
+        """Oldest admissible request; shed ones are failed on the spot."""
+        while True:
+            req = self.queue.get(timeout=None if block else 0.25)
+            if req is None:
+                return None
+            if self._admissible(req):
+                return req
+
+    def next_batch(self, block: bool = False) -> tuple[list[Request], PadSpec] | None:
+        """The next dispatchable micro-batch, or ``None`` if the queue shut
+        down (``block=True``) / stayed empty past the poll (``block=False``)."""
+        first = self._first_live(block)
+        if first is None:
+            return None
+        members = [first]
+        tot_n, tot_e, tot_t = _totals(first.sample)
+        max_n = first.sample.num_nodes
+        flush_at = time.monotonic() + self.flush_s
+        if first.deadline is not None:
+            # never coalesce PAST a member's deadline: a lone request with
+            # deadline < flush_ms on an idle server must dispatch in time,
+            # not wait out the window and get shed at dispatch. The margin
+            # closes the window BEFORE the deadline so the dispatch-time
+            # expiry re-check doesn't see now == deadline.
+            flush_at = min(flush_at, first.deadline - _DISPATCH_MARGIN_S)
+        while len(members) < self.max_graphs:
+            remaining = flush_at - time.monotonic()
+            if remaining <= 0:
+                break
+            req = self.queue.get(timeout=remaining)
+            if req is None:
+                break
+            if not self._admissible(req):
+                continue
+            n, e, t = _totals(req.sample)
+            if self._pick(tot_n + n, tot_e + e, tot_t + t,
+                          len(members) + 1, max(max_n, n)) is None:
+                # no bucket holds AND certifies the would-be batch: dispatch
+                # what we have, the request re-heads the queue for the next
+                # batch (it is individually servable — checked above)
+                self.queue.push_back(req)
+                break
+            members.append(req)
+            tot_n, tot_e, tot_t = tot_n + n, tot_e + e, tot_t + t
+            max_n = max(max_n, n)
+            if req.deadline is not None:
+                flush_at = min(flush_at, req.deadline - _DISPATCH_MARGIN_S)
+        pad = self._pick(tot_n, tot_e, tot_t, len(members), max_n)
+        assert pad is not None  # every admitted member kept the batch viable
+        return members, pad
+
+
+__all__ = ["MicroBatcher", "canonical_meta", "serving_collate"]

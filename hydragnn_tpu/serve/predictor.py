@@ -1,0 +1,118 @@
+"""Shared prediction core: step construction, per-head gather, denormalize.
+
+This is the ONE implementation of "turn a trained state + a padded batch into
+per-head physical-unit predictions" — the batch evaluator (``run_prediction``)
+and the always-hot serving tier (``serve.server``) both execute it, so a
+served answer is bit-identical to what the offline evaluator would report for
+the same fp32 inputs on the same backend. Before this module the predict path
+lived inline in ``run_prediction`` and a server would have had to fork it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.base import head_columns
+from ..train.step import TrainState, make_predict_step, resolve_precision
+
+
+class Predictor:
+    """Model + state + config bound into a reusable predict core.
+
+    ``config`` is the AUGMENTED config dict (post ``update_config``): the
+    precision policy and the denormalization minmax tables are read from it.
+
+    - :meth:`outputs` — run the jitted predict step (var_output squeezed).
+    - :meth:`gather` — per-head (true, pred) arrays for the REAL rows of a
+      batch, exactly the collection loop ``run_prediction`` historically ran.
+    - :meth:`split_graphs` — per-graph views of a batch's outputs, the unit
+      the serving tier hands back to individual requests.
+    - :meth:`denormalize` — min-max denormalization per the config's
+      ``Variables_of_interest`` (no-op unless ``denormalize_output``).
+    """
+
+    def __init__(self, model, state: TrainState, config: dict,
+                 donate_batch: bool = False):
+        self.model = model
+        self.state = state
+        self.spec = model.spec
+        self.voi = config["NeuralNetwork"]["Variables_of_interest"]
+        self.compute_dtype = resolve_precision(
+            config["NeuralNetwork"]["Training"].get("precision", "fp32")
+        )
+        self.predict_step = make_predict_step(
+            model, compute_dtype=self.compute_dtype, donate_batch=donate_batch
+        )
+        self.cols = head_columns(model.spec)
+        self._scales = None
+
+    def outputs(self, batch, step=None):
+        """Per-head prediction arrays for one padded batch (still padded;
+        callers mask). ``step`` overrides the jitted predict step — the
+        serving tier passes its per-bucket AOT executable here."""
+        out = (step or self.predict_step)(self.state, batch)
+        if self.spec.var_output:
+            out = out[0]
+        return out
+
+    def gather(self, batch, out=None):
+        """(trues, preds): per-head arrays holding only the REAL rows of
+        ``batch`` — graph heads masked by ``graph_mask``, node heads by
+        ``node_mask`` (the reference ``test()`` collection,
+        train_validate_test.py:989-1080)."""
+        if out is None:
+            out = self.outputs(batch)
+        trues, preds = [], []
+        for ihead, (kind, col, dim) in enumerate(self.cols):
+            if kind == "graph":
+                mask = np.asarray(batch.graph_mask) > 0
+                trues.append(np.asarray(batch.graph_y[:, col : col + dim])[mask])
+                preds.append(np.asarray(out[ihead])[mask])
+            else:
+                mask = np.asarray(batch.node_mask) > 0
+                trues.append(np.asarray(batch.node_y[:, col : col + dim])[mask])
+                preds.append(np.asarray(out[ihead])[mask])
+        return trues, preds
+
+    def split_graphs(self, out, node_counts):
+        """Split padded per-head outputs into per-graph results.
+
+        ``node_counts``: real node count of each graph, in collate order.
+        Returns a list (one entry per graph) of per-head np arrays: graph
+        heads give the ``[dim]`` row for that graph, node heads the
+        ``[n_i, dim]`` rows of that graph's nodes."""
+        results = [[] for _ in node_counts]
+        offsets = np.concatenate([[0], np.cumsum(node_counts)])
+        for ihead, (kind, _col, _dim) in enumerate(self.cols):
+            arr = np.asarray(out[ihead])
+            for g in range(len(node_counts)):
+                if kind == "graph":
+                    results[g].append(arr[g])
+                else:
+                    results[g].append(arr[offsets[g] : offsets[g + 1]])
+        return results
+
+    def denormalize(self, trues, preds):
+        """Map min-max-normalized values back to physical units when the
+        config asks for it (reference ``postprocess.py:13``)."""
+        if not self.voi.get("denormalize_output"):
+            return trues, preds
+        from ..postprocess.postprocess import output_denormalize
+
+        return output_denormalize(self.voi, trues, preds, self.spec)
+
+    def denormalize_preds(self, preds):
+        """Preds-only denormalize for the serving hot path (no targets exist
+        for a live request; running the paired API on duplicated inputs
+        would double the per-request work). Scales are extracted once and
+        cached — they are a property of the training dataset, not the batch."""
+        if not self.voi.get("denormalize_output"):
+            return preds
+        if self._scales is None:
+            from ..postprocess.postprocess import head_scales
+
+            self._scales = head_scales(self.voi, self.spec)
+        return [p * rng + lo for p, (lo, rng) in zip(preds, self._scales)]
+
+
+__all__ = ["Predictor"]

@@ -1,0 +1,543 @@
+"""Always-hot prediction server: persistent process, warm executables.
+
+``run_prediction`` is a batch evaluator — every invocation pays data loading
+plus the first-compile cost (20-40 s on TPU) before the first answer.
+:class:`PredictionServer` inverts the lifecycle for online traffic:
+
+- **boot**: register models (architecture + trained state + augmented
+  config), derive each endpoint's pad-bucket table (the SAME
+  ``compute_pad_buckets`` table training uses), AOT-lower and compile every
+  (model, bucket) predict program (``utils.compile_cache.aot_compile``, disk
+  cache warm across restarts), and verify with the strict recompile sentinel
+  that a dummy pass through every executable triggers ZERO lowerings;
+- **steady state**: a bounded request queue with typed load-shedding feeds a
+  per-endpoint micro-batcher (``serve.batcher``) whose batches run through
+  the pre-compiled executables only — no jit cache in the hot path, nothing
+  left to compile, donated batch buffers on accelerators;
+- **routing**: several architectures/checkpoints serve concurrently from one
+  process, each endpoint with its own queue, bucket table, executor table,
+  and dispatcher thread — one slow model cannot head-of-line-block another.
+
+Config: the validated top-level ``Serving`` block (``config/schema.py``),
+overridden by ``HYDRAGNN_SERVE_*`` env flags (``utils.flags``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Sequence
+
+import numpy as np
+
+from ..graphs.batching import PadSpec, compute_pad_buckets
+from ..graphs.graph import GraphSample
+from ..train.step import TrainState
+from ..utils import flags
+from .admission import (
+    DeadlineExceededError,
+    IncompatibleSampleError,
+    Request,
+    RequestQueue,
+    ServerClosedError,
+    UnknownModelError,
+)
+from .batcher import MicroBatcher, serving_collate
+from .predictor import Predictor
+
+
+# top-level sections of the repo's JSON config schema — lets from_config
+# tell "full config without a Serving block" (defaults) apart from "typo'd
+# serving block" (raise)
+_CONFIG_SECTIONS = frozenset(
+    {"Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving"}
+)
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """The ``Serving`` config block — these field defaults ARE the schema
+    defaults (single-source, same pattern as ``StoreConfig`` /
+    ``Training.resilience``). Env flags ``HYDRAGNN_SERVE_QUEUE_DEPTH`` /
+    ``_FLUSH_MS`` / ``_WARMUP`` override at server construction."""
+
+    queue_depth: int = 256   # bounded admission; beyond it requests shed
+    flush_ms: float = 5.0    # max micro-batch coalescing latency
+    warmup: bool = True      # AOT-compile every bucket executable at boot
+    max_batch_graphs: int = 0  # per-batch request cap (0 = bucket capacity)
+    deadline_ms: float = 0.0   # default per-request deadline (0 = none)
+
+    @staticmethod
+    def from_config(config: dict | None) -> "ServingConfig":
+        """Accepts a FULL config dict (reads its ``Serving`` block, absent =
+        defaults) or the serving block itself ({"queue_depth": 8, ...} —
+        recognized by its field names; unknown fields then raise instead of
+        silently falling back to defaults)."""
+        config = config or {}
+        block = config.get("Serving")
+        if block is None and config:
+            if any(k in serving_config_defaults() for k in config):
+                block = config  # the caller passed the block directly
+            elif not any(k in _CONFIG_SECTIONS for k in config):
+                # neither serving fields nor config sections: a typo'd
+                # block must raise, not silently boot with defaults
+                raise ValueError(
+                    f"unrecognized serving config keys {sorted(config)}; "
+                    f"expected a full config (sections "
+                    f"{sorted(_CONFIG_SECTIONS)}) or a Serving block "
+                    f"(fields {sorted(serving_config_defaults())})"
+                )
+        return ServingConfig(**(block or {})).apply_env()
+
+    def apply_env(self) -> "ServingConfig":
+        """Fold ``HYDRAGNN_SERVE_*`` overrides in (idempotent). The server
+        applies this on EVERY construction path — a directly-built
+        ``ServingConfig`` honors the flag table the same as a config dict."""
+        depth = flags.get(flags.SERVE_QUEUE_DEPTH)
+        if depth is not None:
+            self.queue_depth = int(depth)
+        flush = flags.get(flags.SERVE_FLUSH_MS)
+        if flush is not None:
+            self.flush_ms = float(flush)
+        warm = flags.get(flags.SERVE_WARMUP)
+        if warm is not None:
+            self.warmup = bool(warm)
+        return self
+
+    def validate(self) -> "ServingConfig":
+        """Range-check every field; the ONE implementation behind both the
+        schema's ``Serving`` block validation and direct server
+        construction (which bypasses ``update_config``)."""
+        if int(self.queue_depth) < 1:
+            raise ValueError(
+                f"Serving.queue_depth must be >= 1, got {self.queue_depth}"
+            )
+        for fkey in ("flush_ms", "deadline_ms"):
+            if float(getattr(self, fkey)) < 0:
+                raise ValueError(
+                    f"Serving.{fkey} must be >= 0, got {getattr(self, fkey)}"
+                )
+        if int(self.max_batch_graphs) < 0:
+            raise ValueError(
+                "Serving.max_batch_graphs must be >= 0 (0 = bucket "
+                f"capacity), got {self.max_batch_graphs}"
+            )
+        return self
+
+
+def serving_config_defaults() -> dict:
+    return dataclasses.asdict(ServingConfig())
+
+
+def _dummy_sample(example: GraphSample) -> GraphSample:
+    """A minimal 1-node, 0-edge sample with ``example``'s feature widths —
+    collated alone it exercises every array field of a bucket, so one AOT
+    lowering per bucket covers every real batch shape of that bucket."""
+    n_y = example.node_y.shape[1]
+    extras = {}
+    if "pe" in example.extras:
+        k = example.extras["pe"].shape[1]
+        extras["pe"] = np.zeros((1, k), np.float32)
+        extras["rel_pe"] = np.zeros((0, k), np.float32)
+    if "idx_kj" in example.extras:
+        extras["idx_kj"] = np.zeros((0,), np.int32)
+        extras["idx_ji"] = np.zeros((0,), np.int32)
+    return GraphSample(
+        x=np.zeros((1, example.x.shape[1]), np.float32),
+        edge_attr=np.zeros((0, example.edge_attr.shape[1]), np.float32),
+        graph_attr=np.zeros_like(example.graph_attr),
+        graph_y=np.zeros_like(example.graph_y),
+        node_y=np.zeros((1, n_y), np.float32),
+        extras=extras,
+    )
+
+
+class ModelEndpoint:
+    """One served model: predictor + bucket table + queue + executor table."""
+
+    def __init__(self, name: str, predictor: Predictor,
+                 buckets: Sequence[PadSpec], example: GraphSample,
+                 cfg: ServingConfig, denormalize: bool = False):
+        self.name = name
+        self.predictor = predictor
+        self.buckets = sorted(buckets, key=lambda p: p.as_tuple())
+        self.example = example
+        self.cfg = cfg
+        self.denormalize = denormalize
+        self.executables: dict[tuple, object] = {}
+        self.thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self.counters = {
+            "submitted": 0, "served": 0, "shed": 0, "shed_deadline": 0,
+            "shed_oversize": 0, "failed": 0, "cancelled": 0,
+            "batches": 0, "real_graph_slots": 0, "graph_slots": 0,
+        }
+        # invariant for the endpoint's lifetime — computed once, compared
+        # against every request on the admission hot path
+        self._want_signature = self._signature(example)
+        self.reset_queue()
+
+    def reset_queue(self) -> None:
+        """Fresh queue + batcher (boot, and re-arm after ``stop()`` — a
+        closed queue cannot be reopened, but a restarted server keeps its
+        warm executables, which is the expensive part)."""
+        self.queue = RequestQueue(self.cfg.queue_depth)
+        self.batcher = MicroBatcher(
+            self.queue, self.buckets, flush_s=self.cfg.flush_ms / 1e3,
+            max_graphs=self.cfg.max_batch_graphs,
+            on_shed=self._on_shed,
+        )
+
+    def _on_shed(self, kind: str) -> None:
+        # "cancelled" = the client cancelled before the batcher could shed;
+        # still a terminal outcome the submitted-total must account for
+        self._count("cancelled" if kind == "cancelled" else f"shed_{kind}")
+
+    def _count(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            self.counters[key] += by
+
+    @staticmethod
+    def _signature(s: GraphSample) -> dict:
+        return {
+            "x_width": s.x.shape[1],
+            "edge_attr_width": s.edge_attr.shape[1],
+            "graph_attr_width": s.graph_attr.shape[0],
+            "graph_y_width": s.graph_y.shape[0],
+            "node_y_width": s.node_y.shape[1],
+            "pe_width": s.extras["pe"].shape[1] if "pe" in s.extras else 0,
+            # collate reads rel_pe whenever pe is present — a pe-with-no-
+            # rel_pe request would KeyError the whole micro-batch
+            "rel_pe_width": (
+                s.extras["rel_pe"].shape[1] if "rel_pe" in s.extras else 0
+            ),
+            # DimeNet endpoints: a triplet-less request would collate fine
+            # (zero triplets) but serve angle-blind predictions silently
+            "has_triplets": "idx_kj" in s.extras,
+        }
+
+    def check_sample(self, s: GraphSample) -> None:
+        """Admission-time schema check: every request must match the
+        feature-width signature the endpoint's executables were compiled
+        for. Without this, ``collate``'s first-sample pe-width rule would
+        let one pe-less request collapse a whole micro-batch's pe arrays
+        (failing the warm executable's shape check, or silently zeroing
+        co-batched requests' PEs on an unwarmed endpoint)."""
+        got = self._signature(s)
+        want = self._want_signature
+        if got != want:
+            mismatch = {k: (got[k], want[k]) for k in got if got[k] != want[k]}
+            raise IncompatibleSampleError(
+                f"sample does not match endpoint {self.name!r}'s signature: "
+                f"(got, want) per field: {mismatch}"
+            )
+
+    def warm(self, verify: bool = True) -> dict:
+        """AOT-lower + compile this endpoint's predict program once per
+        bucket; optionally verify a dummy pass through every executable is
+        lowering-free (the strict-sentinel gate CI runs)."""
+        from ..analysis.sentinel import no_recompile
+        from ..utils.compile_cache import (
+            aot_compile,
+            enable_compile_cache,
+            shape_structs,
+        )
+
+        # here, not only in PredictionServer.warmup(): the lazy start()
+        # warm path must hit the same persistent disk cache across restarts
+        enable_compile_cache()
+        report = {}
+        dummy = _dummy_sample(self.example)
+        for pad in self.buckets:
+            batch = serving_collate([dummy], pad)
+            t0 = time.perf_counter()
+            self.executables[pad.as_tuple()] = aot_compile(
+                self.predictor.predict_step,
+                self.predictor.state,
+                shape_structs(batch),
+            )
+            report[repr(pad)] = round(time.perf_counter() - t0, 4)
+        if verify:
+            with no_recompile(0, what=f"serving warm-up verify [{self.name}]"):
+                for pad in self.buckets:
+                    self.executables[pad.as_tuple()](
+                        self.predictor.state, serving_collate([dummy], pad)
+                    )
+        return report
+
+    def _step_for(self, pad: PadSpec):
+        exe = self.executables.get(pad.as_tuple())
+        # warmup=False endpoints lazily fall back to the jitted step: first
+        # use of a (bucket) treedef compiles, steady state then hits the jit
+        # cache — the strict sentinel only certifies warmed endpoints
+        return exe if exe is not None else self.predictor.predict_step
+
+    def serve_batch(self, members: list[Request], pad: PadSpec) -> None:
+        # dispatch-time gate: re-check deadlines (a request can expire while
+        # the flush window coalesces joiners — serving it anyway would
+        # return a "success" past its contract) and CLAIM every future so a
+        # client-side cancel can never InvalidStateError the dispatcher
+        live = []
+        for req in members:
+            if req.expired():
+                if req.reject(DeadlineExceededError(
+                    "deadline passed while the batch coalesced"
+                )):
+                    self._count("shed_deadline")
+                else:
+                    self._count("cancelled")  # client's cancel won the race
+            elif req.claim():
+                live.append(req)
+            else:
+                self._count("cancelled")  # client cancelled while queued
+        members = live
+        if not members:
+            return
+        try:
+            batch = serving_collate([r.sample for r in members], pad)
+            out = self.predictor.outputs(batch, step=self._step_for(pad))
+            counts = [r.sample.num_nodes for r in members]
+            per_graph = self.predictor.split_graphs(out, counts)
+            if self.denormalize:
+                per_graph = [
+                    self.predictor.denormalize_preds(heads)
+                    for heads in per_graph
+                ]
+            now = time.monotonic()
+            self._count("batches")
+            self._count("real_graph_slots", len(members))
+            self._count("graph_slots", pad.n_graph - 1)
+            self._count("served", len(members))
+            for req, heads in zip(members, per_graph):
+                req.future.set_result({
+                    "heads": heads,
+                    "latency_s": now - req.enqueued_at,
+                    "bucket": pad.as_tuple(),
+                    "batch_graphs": len(members),
+                })
+        except Exception as exc:  # fail THIS batch's futures, keep serving
+            self._count("failed", len(members))
+            for req in members:
+                if not req.future.done():  # claimed above: cancel impossible
+                    req.future.set_exception(exc)
+
+
+class PredictionServer:
+    """The persistent multi-model prediction process. Lifecycle:
+
+        server = PredictionServer(config)          # or ServingConfig()
+        server.add_model("mace_v2", model, state, aug_config, samples=train)
+        server.warmup()                            # AOT, strict-verified
+        server.start()
+        fut = server.submit("mace_v2", sample, deadline_ms=50)
+        result = fut.result()["heads"]             # per-head arrays
+        server.stop()
+    """
+
+    def __init__(self, config: ServingConfig | dict | None = None):
+        if isinstance(config, ServingConfig):
+            # copy before folding env in — the caller's object stays as built
+            self.cfg = dataclasses.replace(config).apply_env()
+        else:
+            self.cfg = ServingConfig.from_config(config)
+        # ServingConfig / raw-dict construction bypasses update_config
+        self.cfg.validate()
+        self._models: dict[str, ModelEndpoint] = {}
+        self._running = False
+        self._stopping = False
+
+    # -- registration / warm-up ---------------------------------------------
+
+    def add_model(
+        self,
+        name: str,
+        model,
+        state: TrainState,
+        config: dict,
+        samples: Sequence[GraphSample] | None = None,
+        buckets: Sequence[PadSpec] | None = None,
+        example: GraphSample | None = None,
+        batch_size: int | None = None,
+        max_buckets: int = 4,
+        denormalize: bool = False,
+        flush_ms: float | None = None,
+        max_batch_graphs: int | None = None,
+    ) -> ModelEndpoint:
+        """Register one servable model. ``config`` is its AUGMENTED config;
+        the bucket table comes from ``buckets`` (explicit) or is derived from
+        ``samples`` with the training-side ``compute_pad_buckets``. One
+        ``example`` sample (default ``samples[0]``) fixes the endpoint's
+        feature-width signature — warm-up shapes AND the admission-time
+        schema check every request is validated against. Endpoint kwargs
+        override the server-wide batching policy per model."""
+        if self._running:
+            raise RuntimeError("add_model before start(): registration is a boot-time operation")
+        if name in self._models:
+            raise ValueError(f"model {name!r} already registered")
+        if buckets is None:
+            if not samples:
+                raise ValueError(
+                    "add_model needs `samples` to derive the bucket table "
+                    "(or pass `buckets` plus an `example` sample)"
+                )
+            bs = int(batch_size or config["NeuralNetwork"]["Training"].get("batch_size", 32))
+            buckets = compute_pad_buckets(samples, bs, max_buckets=max_buckets)
+        if example is None and samples:
+            example = samples[0]
+        if example is None:
+            raise ValueError(
+                "add_model needs an `example` sample (or `samples`) to fix "
+                "the endpoint's feature-width signature"
+            )
+        cfg = dataclasses.replace(
+            self.cfg,
+            flush_ms=self.cfg.flush_ms if flush_ms is None else float(flush_ms),
+            max_batch_graphs=(
+                self.cfg.max_batch_graphs if max_batch_graphs is None
+                else int(max_batch_graphs)
+            ),
+        )
+        predictor = Predictor(model, state, config, donate_batch=True)
+        ep = ModelEndpoint(name, predictor, buckets, example, cfg,
+                           denormalize=denormalize)
+        self._models[name] = ep
+        return ep
+
+    def warmup(self, verify: bool = True) -> dict:
+        """Boot-time compile of every (model, bucket) executable. The
+        persistent XLA disk cache is enabled (inside ``ModelEndpoint.warm``),
+        so a restarted server re-lowers but skips the backend compile.
+        Returns per-model per-bucket compile seconds — the README's warm-up
+        cost table is this dict."""
+        t0 = time.perf_counter()
+        report = {
+            name: ep.warm(verify=verify) for name, ep in self._models.items()
+        }
+        report["total_s"] = round(time.perf_counter() - t0, 4)
+        return report
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "PredictionServer":
+        if self._running:
+            return self
+        if not self._models:
+            raise RuntimeError("no models registered")
+        if self.cfg.warmup:
+            for ep in self._models.values():
+                if not ep.executables:
+                    ep.warm(verify=False)
+        self._stopping = False
+        for ep in self._models.values():
+            if ep.queue.closed:  # restart after stop(): re-arm the queue
+                ep.reset_queue()
+        for ep in self._models.values():
+            ep.thread = threading.Thread(
+                target=self._dispatch_loop, args=(ep,),
+                name=f"serve-{ep.name}", daemon=True,
+            )
+            ep.thread.start()
+        self._running = True
+        return self
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self._stopping = True
+        for ep in self._models.values():
+            for req in ep.queue.close():
+                # drained futures are PENDING or client-CANCELLED (never
+                # dispatched); reject() is safe for both, and either way the
+                # request terminated unserved — count it
+                req.reject(
+                    ServerClosedError("server stopped with the request queued")
+                )
+                ep._count("cancelled")  # keeps submitted == resolved sum
+        for ep in self._models.values():
+            if ep.thread is not None:
+                ep.thread.join(timeout=10.0)
+        self._running = False
+
+    def __enter__(self) -> "PredictionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _dispatch_loop(self, ep: ModelEndpoint) -> None:
+        batcher = ep.batcher  # this run's batcher: a restart makes a new one
+        while True:
+            got = batcher.next_batch(block=False)
+            if got is None:
+                # timeout poll (shutdown responsiveness) — or the queue was
+                # closed, which must END the thread, not spin it hot
+                if self._stopping or batcher.queue.closed:
+                    return
+                continue
+            ep.serve_batch(*got)
+
+    # -- request plane ------------------------------------------------------
+
+    def submit(self, model: str, sample: GraphSample,
+               deadline_ms: float | None = None) -> Future:
+        """Admit one request; returns its Future. Sheds with a typed
+        exception RAISED here when admission fails (queue full / unknown
+        model / stopped server) — the future path is only for requests that
+        were actually admitted."""
+        ep = self._models.get(model)
+        if ep is None:
+            raise UnknownModelError(
+                f"no model {model!r}; serving: {sorted(self._models)}"
+            )
+        if not self._running:
+            raise ServerClosedError("server not started")
+        if deadline_ms is None and self.cfg.deadline_ms:
+            deadline_ms = self.cfg.deadline_ms
+        deadline = (
+            time.monotonic() + deadline_ms / 1e3 if deadline_ms else None
+        )
+        req = Request(sample=sample, deadline=deadline)
+        ep._count("submitted")
+        try:
+            # admission-layer sheds (schema mismatch, queue full, closing
+            # race) all land in the 'shed' counter — an operator watching
+            # stats() sees misrouted traffic, not just backpressure
+            ep.check_sample(sample)
+            ep.queue.put(req)
+        except Exception:
+            ep._count("shed")
+            raise
+        return req.future
+
+    def predict(self, model: str, samples: Sequence[GraphSample],
+                deadline_ms: float | None = None, timeout: float = 60.0):
+        """Synchronous convenience: submit every sample, wait, return the
+        per-request ``heads`` lists in order."""
+        futures = [self.submit(model, s, deadline_ms=deadline_ms) for s in samples]
+        return [f.result(timeout=timeout)["heads"] for f in futures]
+
+    def stats(self) -> dict:
+        """Per-model serving counters, plus batch occupancy (real graphs per
+        padded graph slot — the micro-batcher's packing efficiency)."""
+        out = {}
+        for name, ep in self._models.items():
+            with ep._lock:
+                c = dict(ep.counters)
+            c["queue_depth"] = len(ep.queue)
+            c["buckets"] = [b.as_tuple() for b in ep.buckets]
+            c["warm_executables"] = len(ep.executables)
+            c["occupancy"] = round(
+                c["real_graph_slots"] / c["graph_slots"], 4
+            ) if c["graph_slots"] else None
+            out[name] = c
+        return out
+
+
+__all__ = [
+    "ModelEndpoint",
+    "PredictionServer",
+    "ServingConfig",
+    "serving_config_defaults",
+]

@@ -1,0 +1,133 @@
+"""Synthetic serving traffic: open/closed-loop request generation + latency
+accounting. The bench driver's ``serving_ab`` row and capacity experiments
+both drive :class:`PredictionServer` through this one generator so p50/p99
+and graphs/sec are measured the same way everywhere.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .admission import AdmissionError, DeadlineExceededError, QueueFullError
+
+
+@dataclass
+class TrafficReport:
+    """Latency/throughput summary of one traffic run. Latency is the
+    client-observed submit→result-available wall time per request (measured
+    via a done-callback on each future: queueing + coalescing wait +
+    dispatch + result split + delivery into the future — everything short of
+    the waiter's own wakeup scheduling, which no single-process measurement
+    can see)."""
+
+    n_requests: int = 0
+    n_served: int = 0
+    n_shed: int = 0
+    n_deadline: int = 0
+    wall_s: float = 0.0
+    latencies_s: list = field(default_factory=list)
+
+    def percentile_ms(self, q: float) -> float | None:
+        if not self.latencies_s:
+            return None
+        return round(1e3 * float(np.percentile(self.latencies_s, q)), 3)
+
+    def summary(self) -> dict:
+        return {
+            "n_requests": self.n_requests,
+            "n_served": self.n_served,
+            "n_shed": self.n_shed,
+            "n_deadline_exceeded": self.n_deadline,
+            "p50_ms": self.percentile_ms(50),
+            "p99_ms": self.percentile_ms(99),
+            "graphs_per_sec": (
+                round(self.n_served / self.wall_s, 2) if self.wall_s > 0 else None
+            ),
+            "wall_s": round(self.wall_s, 4),
+        }
+
+
+def run_traffic(
+    server,
+    model: str,
+    samples,
+    n_requests: int,
+    rate_hz: float | None = None,
+    seed: int = 0,
+    deadline_ms: float | None = None,
+    timeout_s: float = 120.0,
+) -> TrafficReport:
+    """Drive ``n_requests`` single-graph requests at the server, drawing
+    samples uniformly (seeded) from ``samples``.
+
+    ``rate_hz``: open-loop Poisson arrivals at that mean rate — the
+    "millions of users" shape, where arrival times don't wait for results.
+    ``None`` = closed burst: submit as fast as admission allows (admission
+    shedding then exercises the bounded queue; shed requests are retried
+    once after a short backoff, then counted shed).
+    """
+    rng = np.random.default_rng(seed)
+    order = rng.integers(0, len(samples), size=n_requests)
+    report = TrafficReport(n_requests=n_requests)
+    futures = []
+    latencies = []  # appended from done-callbacks (dispatcher threads)
+
+    def _submit(sample):
+        t_sub = time.perf_counter()
+        fut = server.submit(model, sample, deadline_ms=deadline_ms)
+
+        def _done(f, t_sub=t_sub):
+            if f.exception() is None:
+                # submit -> result-available: the client-observed latency,
+                # stamped the instant the future resolves (polling result()
+                # later would overstate early-completing requests)
+                latencies.append(time.perf_counter() - t_sub)
+
+        fut.add_done_callback(_done)
+        futures.append(fut)
+
+    t0 = time.perf_counter()
+    next_arrival = t0
+    for i in range(n_requests):
+        if rate_hz:
+            next_arrival += float(rng.exponential(1.0 / rate_hz))
+            now = time.perf_counter()
+            if next_arrival > now:
+                time.sleep(next_arrival - now)
+        sample = samples[int(order[i])]
+        try:
+            _submit(sample)
+        except QueueFullError:
+            # queue-full is the RETRYABLE rejection (backpressure): one
+            # retry after a beat, still-full counts as shed. Every other
+            # admission error (unknown model, incompatible sample, closed
+            # server) is a configuration bug — propagate, don't launder it
+            # into the shed count.
+            time.sleep(0.002)
+            try:
+                _submit(sample)
+            except QueueFullError:
+                report.n_shed += 1
+    for fut in futures:
+        try:
+            fut.result(timeout=timeout_s)
+            report.n_served += 1
+        except DeadlineExceededError:
+            report.n_deadline += 1
+        except AdmissionError:
+            report.n_shed += 1
+    report.wall_s = time.perf_counter() - t0
+    # result() can unblock BEFORE the future's done-callback runs (waiters
+    # are notified first in CPython), so give the last callbacks a bounded
+    # beat to land — otherwise the tail request's latency goes missing
+    wait_until = time.perf_counter() + 1.0
+    while len(latencies) < report.n_served and time.perf_counter() < wait_until:
+        time.sleep(0.001)
+    report.latencies_s = list(latencies)
+    return report
+
+
+__all__ = ["TrafficReport", "run_traffic"]

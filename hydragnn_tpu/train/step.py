@@ -246,10 +246,23 @@ def make_eval_step(model: HydraModel, compute_dtype=jnp.float32):
     return eval_step
 
 
-def make_predict_step(model: HydraModel, compute_dtype=jnp.float32):
-    """(state, batch) -> per-head predictions (host gathers across batches)."""
+def make_predict_step(model: HydraModel, compute_dtype=jnp.float32,
+                      donate_batch: bool = False):
+    """(state, batch) -> per-head predictions (host gathers across batches).
 
-    @jax.jit
+    ``donate_batch``: donate the batch buffers to the step — the serving
+    tier's steady-state executor consumes each micro-batch exactly once, so
+    its device buffers can be reused in place (accelerators only; CPU keeps
+    no-donation like ``donate_state_argnums`` so tests can inspect inputs).
+    """
+    donated: tuple = ()
+    if donate_batch:
+        try:
+            donated = (1,) if jax.default_backend() == "tpu" else ()
+        except Exception:
+            donated = ()
+
+    @functools.partial(jax.jit, donate_argnums=donated)
     def predict_step(state: TrainState, batch: GraphBatch):
         c_params = _cast_floats(state.params, compute_dtype)
         c_batch = _cast_floats(batch, compute_dtype)

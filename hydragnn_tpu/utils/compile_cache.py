@@ -38,3 +38,33 @@ def enable_compile_cache(default_dir: str = "./.jax_cache") -> str | None:
         return setting
     except Exception:
         return None
+
+
+def shape_structs(tree):
+    """Abstract twin of a pytree of arrays: every leaf becomes a
+    ``jax.ShapeDtypeStruct`` (static aux data — ``BatchMeta`` — passes
+    through untouched). Lets AOT warm-up lower against a batch *signature*
+    without materializing or transferring batch-sized buffers."""
+    import jax
+    import numpy as np
+
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype), tree
+    )
+
+
+def aot_compile(jitted, *args):
+    """Ahead-of-time lower + compile one signature of a jitted callable and
+    return the executable: ``aot_compile(fn, state, shape_structs(batch))``.
+
+    The returned executable is invoked directly (``compiled(state, batch)``)
+    and never re-traces — zero ``jaxpr_to_mlir_module`` events per call, which
+    is what lets the serving tier's steady state pass the strict recompile
+    sentinel. Pair with :func:`enable_compile_cache` first so the backend
+    compile itself hits the persistent disk cache across process restarts
+    (the 20-40 s first-compile cost becomes a one-time cost per cache dir).
+
+    Args may mix concrete arrays (live params) and ``ShapeDtypeStruct``
+    signatures (the per-bucket batch shape).
+    """
+    return jitted.lower(*args).compile()

@@ -147,6 +147,27 @@ PEER_TIMEOUT = _register(Flag(
     "than this counts as DOWN: the fetch fails over to a replica and the "
     "peer is quarantined until a background probe sees it answer again."))
 
+# -- serving (hydragnn_tpu.serve) -------------------------------------------
+SERVE_QUEUE_DEPTH = _register(Flag(
+    "HYDRAGNN_SERVE_QUEUE_DEPTH", "int", None,
+    "Bounded request-queue depth per served model (overrides "
+    "Serving.queue_depth, default 256). Admission beyond it sheds the "
+    "request with a typed QueueFullError — the backpressure signal for "
+    "clients; deeper queues trade shed rate for tail latency."))
+SERVE_FLUSH_MS = _register(Flag(
+    "HYDRAGNN_SERVE_FLUSH_MS", "float", None,
+    "Micro-batch max-latency flush timer in ms (overrides "
+    "Serving.flush_ms, default 5). The first queued request opens the "
+    "window; requests arriving inside it coalesce into the tightest pad "
+    "bucket. 0 = dispatch immediately (per-request batches)."))
+SERVE_WARMUP = _register(Flag(
+    "HYDRAGNN_SERVE_WARMUP", "bool", None,
+    "AOT-compile every (model, bucket) predict executable at server boot "
+    "(overrides Serving.warmup, default on). =0 defers to lazy jit on "
+    "first use — first requests then pay the compile the warm-up was "
+    "built to hide; the strict zero-recompile guarantee only holds for "
+    "warmed endpoints."))
+
 # -- kernels / compilation --------------------------------------------------
 FUSED_SCATTER = _register(Flag(
     "HYDRAGNN_FUSED_SCATTER", "bool", None,

@@ -116,6 +116,11 @@ ARCH_OVERRIDES = {
 }
 
 
+# slow (NOTES r10): ~100 s per architecture — the full sweep alone is ~20 min
+# and was truncating the 870 s tier-1 window. The two GIN gates above stay in
+# the non-slow suite as the e2e canary; the per-arch sweep runs with
+# ``pytest -m slow`` (or no marker filter).
+@pytest.mark.slow
 @pytest.mark.parametrize("arch", sorted(ARCH_OVERRIDES))
 def test_invariant_arch_convergence(arch):
     run_arch_e2e(arch, overrides=ARCH_OVERRIDES[arch], multihead=True)
