@@ -162,6 +162,25 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
         serving_cfg.setdefault(key, val)
     ServingConfig(**serving_cfg).validate()  # one range-check implementation
 
+    # on-device MD (hydragnn_tpu.md): the top-level MD block's defaults ARE
+    # the MDConfig dataclass field defaults (same single-source pattern);
+    # HYDRAGNN_FUSED_CELL_LIST overrides fused_cell_list at build time.
+    md_cfg = config.setdefault("MD", {})
+    if not isinstance(md_cfg, dict):
+        raise ValueError(f"MD must be a dict, got {type(md_cfg).__name__}")
+    from ..md import MDConfig, md_config_defaults
+
+    md_defaults = md_config_defaults()
+    unknown_md = set(md_cfg) - set(md_defaults)
+    if unknown_md:
+        raise ValueError(
+            f"Unknown MD key(s) {sorted(unknown_md)}; known: "
+            f"{sorted(md_defaults)}"
+        )
+    for key, val in md_defaults.items():
+        md_cfg.setdefault(key, val)
+    MDConfig(**md_cfg).validate()  # one range-check implementation
+
     # --- GPS / encoding defaults (reference :40-48) ---
     arch.setdefault("global_attn_engine", None)
     arch.setdefault("global_attn_type", None)

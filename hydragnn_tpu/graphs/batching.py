@@ -257,6 +257,11 @@ def _batch_meta(
         segment_window,
         window_fits_host,
     )
+    from ..ops.fused_softmax import (
+        SM_CERT_BLOCK,
+        SM_CERT_WINDOW,
+        self_loop_pad,
+    )
 
     largest = int(n_node.max()) if n_node.size else 0
     pow2 = max(1 << max(largest - 1, 0).bit_length(), 8)
@@ -285,6 +290,18 @@ def _batch_meta(
         pool_fits=window_fits_host(batch, G, segment_window(G), 256,
                                    exempt_pad_id=True),
         max_n_node=bound,
+        # the fused segment-softmax contract for the EXACT array GAT builds:
+        # receivers + alignment pad (id N-1, exempt) + arange(N) self-loops.
+        # self_loop_pad keeps the arange section block-aligned so its
+        # 256-blocks span exactly the 256 window.
+        attn_fits=window_fits_host(
+            np.concatenate([
+                receivers,
+                np.full(self_loop_pad(receivers.shape[0]), N - 1, np.int32),
+                np.arange(N, dtype=np.int32),
+            ]),
+            N, SM_CERT_WINDOW, SM_CERT_BLOCK, exempt_pad_id=True,
+        ),
     )
 
 

@@ -50,6 +50,10 @@ class BatchMeta(NamedTuple):
     - ``recv_fits`` / ``send_fits`` / ``pool_fits``: the scatter-only kernel's
       contract (window 128) for edge→node reductions keyed by receivers /
       senders and node→graph pooling keyed by ``batch``.
+    - ``attn_fits``: the fused segment-softmax kernel's contract
+      (``ops.fused_softmax``, window 256) for the self-loop-extended receiver
+      array GAT attention builds (real edges + ``self_loop_pad`` alignment
+      slots + one arange(N) self-loop section).
     - ``max_n_node``: static upper bound on per-graph node count (rounded up
       to a power of two so retrace count stays O(log N)); lets GPS pick
       dense-block vs flat attention at trace time.
@@ -60,6 +64,7 @@ class BatchMeta(NamedTuple):
     send_fits: bool | None = None
     pool_fits: bool | None = None
     max_n_node: int | None = None
+    attn_fits: bool | None = None
 
     @staticmethod
     def merge(metas: "list[BatchMeta | None]") -> "BatchMeta | None":
@@ -83,6 +88,7 @@ class BatchMeta(NamedTuple):
                 if any(m.max_n_node is None for m in metas)
                 else max(m.max_n_node for m in metas)
             ),
+            attn_fits=all_or_none([m.attn_fits for m in metas]),
         )
 
 

@@ -99,14 +99,33 @@ def segment_std(
 
 
 def segment_softmax(
-    logits: Array, segment_ids: Array, num_segments: int, hints=None
+    logits: Array, segment_ids: Array, num_segments: int, hints=None,
+    fits: bool | None = None,
 ) -> Array:
     """Numerically-stable softmax within each segment (GAT attention weights).
 
     Returns an array the same shape as ``logits``; padded entries (pointing at
     the dummy segment) get well-defined finite values and must be masked by the
     caller if they would otherwise contribute.
-    """
+
+    2D ``[E, H]`` logits route through the fused Pallas kernel
+    (``hydragnn_tpu.ops.fused_softmax``) when enabled — one windowed pass
+    instead of the four-segment-op chain below. A/B switch:
+    ``HYDRAGNN_FUSED_SOFTMAX=0|1`` (default: on for TPU). ``fits`` is an
+    explicit layout certificate for id arrays the caller built itself (GAT's
+    self-loop-extended receivers carry ``BatchMeta.attn_fits``); otherwise
+    ``hints.seg_hint`` resolves collate's certificate for the batch's own id
+    arrays. The fused kernel's out-of-window (pad-exempt dummy) entries get
+    0 instead of this chain's finite nonzero value — both are defined only
+    up to the caller's mask."""
+    from ..ops import fused_softmax
+
+    if logits.ndim == 2 and fused_softmax._auto_enabled():
+        if fits is None and hints is not None:
+            fits = hints.seg_hint(segment_ids)
+        return fused_softmax.fused_segment_softmax(
+            logits, segment_ids, num_segments, fits=fits
+        )
     seg_max = jax.ops.segment_max(
         jax.lax.stop_gradient(logits), segment_ids, num_segments=num_segments
     )

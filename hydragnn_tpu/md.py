@@ -28,6 +28,7 @@ image PBC, matching ``graphs.radius.radius_graph`` (tested for parity).
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 from typing import Callable, NamedTuple
 
@@ -36,6 +37,63 @@ import jax.numpy as jnp
 import numpy as np
 
 Array = jax.Array
+
+
+@dataclasses.dataclass
+class MDConfig:
+    """The top-level ``MD`` config block — these field defaults ARE the
+    schema defaults (single-source, the ``ServingConfig``/``StoreConfig``
+    pattern; ``config/schema.py`` validates the block against them).
+    ``HYDRAGNN_FUSED_CELL_LIST`` overrides ``fused_cell_list`` at build
+    time (``binned_radius_graph``)."""
+
+    neighbor: str = "auto"          # dense | cell | auto (see make_md_step)
+    capacity_factor: float = 2.5    # plan_cell_grid per-cell slot headroom
+    fused_cell_list: bool | None = None  # None = flag/backend auto
+
+    @staticmethod
+    def from_config(config: dict | None) -> "MDConfig":
+        """Read a full config dict's ``MD`` block (absent = defaults)."""
+        block = (config or {}).get("MD") or {}
+        unknown = set(block) - set(md_config_defaults())
+        if unknown:
+            raise ValueError(
+                f"Unknown MD key(s) {sorted(unknown)}; known: "
+                f"{sorted(md_config_defaults())}"
+            )
+        return MDConfig(**block).validate()
+
+    def validate(self) -> "MDConfig":
+        if self.neighbor not in ("auto", "cell", "dense"):
+            raise ValueError(
+                f"MD.neighbor must be 'auto', 'cell', or 'dense', got "
+                f"{self.neighbor!r}"
+            )
+        if float(self.capacity_factor) <= 1.0:
+            raise ValueError(
+                "MD.capacity_factor must be > 1 (per-cell slot headroom), "
+                f"got {self.capacity_factor}"
+            )
+        if self.fused_cell_list is not None and not isinstance(
+            self.fused_cell_list, bool
+        ):
+            raise ValueError(
+                "MD.fused_cell_list must be true/false/null, got "
+                f"{self.fused_cell_list!r}"
+            )
+        return self
+
+    def step_kwargs(self) -> dict:
+        """Kwargs for ``make_md_step`` / ``make_langevin_step`` / ``run_md``."""
+        return {
+            "neighbor": self.neighbor,
+            "fused": self.fused_cell_list,
+            "capacity_factor": float(self.capacity_factor),
+        }
+
+
+def md_config_defaults() -> dict:
+    return dataclasses.asdict(MDConfig())
 
 
 def dynamic_radius_graph(
@@ -144,6 +202,7 @@ def binned_radius_graph(
     grid: tuple[int, int, int],
     capacity: int,
     pad_id: int = 0,
+    fused: bool | None = None,
 ):
     """Jit-able cell-list radius graph with static shapes: O(N x 27 x
     capacity) memory instead of the dense O(N^2) matrix — ~10k-100k atoms
@@ -156,7 +215,27 @@ def binned_radius_graph(
     dropped from the candidate set) the returned ``n_edges`` is poisoned to
     ``max_edges + max_occupancy`` — the caller's existing
     ``n_edges <= max_edges`` telltale trips instead of silently missing
-    edges. ``grid``/``capacity`` come from ``plan_cell_grid`` (static)."""
+    edges. ``grid``/``capacity`` come from ``plan_cell_grid`` (static).
+
+    ``fused`` routes the build through the Pallas cell-list kernel
+    (``ops.fused_cell_list``): the candidate walk + distance filter run in
+    one windowed pass over cell-sorted atoms instead of materializing the
+    ``[n, 27*capacity]`` candidate/displacement matrices below in HBM. Same
+    edge SET, shifts, masks, and overflow poison; edge ORDER is cell-major
+    instead of atom-major (consumers reduce over edges, so results differ
+    only by fp association). Default (None): ``HYDRAGNN_FUSED_CELL_LIST``
+    env flag, else on for TPU backends; statically ineligible geometries
+    fall through to the XLA build either way."""
+    from .ops import fused_cell_list
+
+    if fused is None:
+        fused = fused_cell_list._auto_enabled()
+    if fused:
+        out = fused_cell_list.fused_binned_radius_graph(
+            pos, cutoff, max_edges, cell, pbc, grid, capacity, pad_id=pad_id
+        )
+        if out is not None:
+            return out
     n = pos.shape[0]
     gx, gy, gz = (int(g) for g in grid)
     n_cells = gx * gy * gz
@@ -238,7 +317,8 @@ class MDState(NamedTuple):
 
 
 def _make_potential_and_init(
-    energy_fn, cutoff, max_edges, cell, pbc, pad_id, neighbor="auto"
+    energy_fn, cutoff, max_edges, cell, pbc, pad_id, neighbor="auto",
+    fused=None, capacity_factor=2.5,
 ):
     """Shared wiring for every integrator: the graph-rebuild potential and
     the initial-state constructor — one place for the neighbor/pad
@@ -246,7 +326,10 @@ def _make_potential_and_init(
 
     ``neighbor``: "dense" = O(N^2) matrix build, "cell" = binned cell list
     (requires a periodic ``cell`` big enough for a 3x3x3 grid — raises
-    otherwise), "auto" = cell list when plannable and N >= 512, else dense."""
+    otherwise), "auto" = cell list when plannable and N >= 512, else dense.
+    ``fused``: Pallas cell-list kernel routing (``binned_radius_graph``).
+    ``capacity_factor``: per-cell slot headroom for ``plan_cell_grid`` —
+    raise it (MD.capacity_factor) after an ``n_edges`` overflow telltale."""
 
     if neighbor not in ("auto", "cell", "dense"):
         raise ValueError(
@@ -257,7 +340,8 @@ def _make_potential_and_init(
         spec = None
         if neighbor in ("auto", "cell") and cell is not None and pbc is not None:
             spec = plan_cell_grid(
-                np.asarray(cell), cutoff, pos.shape[0], pbc=np.asarray(pbc)
+                np.asarray(cell), cutoff, pos.shape[0],
+                capacity_factor=capacity_factor, pbc=np.asarray(pbc),
             )
         if neighbor == "cell" and spec is None:
             raise ValueError(
@@ -268,7 +352,7 @@ def _make_potential_and_init(
         if spec is not None and (neighbor == "cell" or pos.shape[0] >= 512):
             s, r, sh, em, ne = binned_radius_graph(
                 pos, cutoff, max_edges, cell, pbc, spec[0], spec[1],
-                pad_id=pad_id,
+                pad_id=pad_id, fused=fused,
             )
         else:
             s, r, sh, em, ne = dynamic_radius_graph(
@@ -303,6 +387,8 @@ def make_md_step(
     pbc: Array | None = None,
     pad_id: int = 0,
     neighbor: str = "auto",
+    fused: bool | None = None,
+    capacity_factor: float = 2.5,
 ):
     """Velocity-Verlet step with on-device graph rebuild.
 
@@ -315,7 +401,8 @@ def make_md_step(
     list at >= 512 atoms when the periodic cell allows it."""
     m = jnp.asarray(masses).reshape(-1, 1)
     potential, init = _make_potential_and_init(
-        energy_fn, cutoff, max_edges, cell, pbc, pad_id, neighbor=neighbor
+        energy_fn, cutoff, max_edges, cell, pbc, pad_id, neighbor=neighbor,
+        fused=fused, capacity_factor=capacity_factor,
     )
 
     @jax.jit
@@ -345,6 +432,8 @@ def run_md(
     record_every: int = 1,
     pad_id: int = 0,
     neighbor: str = "auto",
+    fused: bool | None = None,
+    capacity_factor: float = 2.5,
 ):
     """Roll a trajectory fully on device: ``lax.scan`` over MD steps, one
     compiled program. Returns (final_state, stacked recorded MDStates)."""
@@ -355,7 +444,8 @@ def run_md(
         )
     init, step = make_md_step(
         energy_fn, masses, dt, cutoff, max_edges, cell=cell, pbc=pbc,
-        pad_id=pad_id, neighbor=neighbor,
+        pad_id=pad_id, neighbor=neighbor, fused=fused,
+        capacity_factor=capacity_factor,
     )
     state = init(jnp.asarray(pos), jnp.asarray(vel))
     n_rec = n_steps // record_every
@@ -386,6 +476,8 @@ def make_langevin_step(
     pbc: Array | None = None,
     pad_id: int = 0,
     neighbor: str = "auto",
+    fused: bool | None = None,
+    capacity_factor: float = 2.5,
 ):
     """NVT Langevin integrator (BAOAB splitting): the velocity-Verlet B/A
     halves wrap an Ornstein-Uhlenbeck velocity kick, which is exact for the
@@ -396,7 +488,8 @@ def make_langevin_step(
     c1 = jnp.exp(-friction * dt)
     c2 = jnp.sqrt(temperature * (1.0 - c1 * c1))
     potential, init = _make_potential_and_init(
-        energy_fn, cutoff, max_edges, cell, pbc, pad_id, neighbor=neighbor
+        energy_fn, cutoff, max_edges, cell, pbc, pad_id, neighbor=neighbor,
+        fused=fused, capacity_factor=capacity_factor,
     )
 
     @jax.jit
@@ -598,8 +691,8 @@ def kinetic_energy(vel: Array, masses: Array) -> Array:
 
 
 __all__ = [
-    "MDState", "NPTState", "binned_radius_graph", "dynamic_radius_graph",
-    "kinetic_energy", "make_berendsen_npt_step", "make_langevin_step",
-    "make_md_step", "mlip_energy_fn", "plan_cell_grid", "run_md",
-    "temperature_of",
+    "MDConfig", "MDState", "NPTState", "binned_radius_graph",
+    "dynamic_radius_graph", "kinetic_energy", "make_berendsen_npt_step",
+    "make_langevin_step", "make_md_step", "md_config_defaults",
+    "mlip_energy_fn", "plan_cell_grid", "run_md", "temperature_of",
 ]

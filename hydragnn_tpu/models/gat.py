@@ -50,12 +50,25 @@ class GATConv(nn.Module):
         x_r = nn.Dense(H * F, name="lin_r")(inv).reshape(N, H, F)
         att = self.param("att", nn.initializers.lecun_normal(), (H, F))
 
-        # real edges + one self-loop slot per node (static shapes)
-        senders = jnp.concatenate([batch.senders, jnp.arange(N, dtype=batch.senders.dtype)])
-        receivers = jnp.concatenate(
-            [batch.receivers, jnp.arange(N, dtype=batch.receivers.dtype)]
-        )
-        e_mask = jnp.concatenate([batch.edge_mask, jnp.ones((N,), batch.edge_mask.dtype)])
+        # real edges + one self-loop slot per node (static shapes), with
+        # `self_loop_pad` masked alignment slots between the sections so the
+        # arange section starts on a fused-softmax block boundary — the
+        # layout BatchMeta.attn_fits certifies (ops/fused_softmax.py). The
+        # pad slots are dummy-wired (node N-1, mask 0): their logits are
+        # masked to -1e9 below and their messages are zeroed, so the XLA
+        # path's results are bit-unchanged by the extra slots.
+        from ..ops.fused_softmax import self_loop_pad
+
+        sl_pad = self_loop_pad(batch.num_edges)
+        pad_ids = jnp.full((sl_pad,), N - 1, batch.senders.dtype)
+        loop = jnp.arange(N, dtype=batch.senders.dtype)
+        senders = jnp.concatenate([batch.senders, pad_ids, loop])
+        receivers = jnp.concatenate([batch.receivers, pad_ids, loop])
+        e_mask = jnp.concatenate([
+            batch.edge_mask,
+            jnp.zeros((sl_pad,), batch.edge_mask.dtype),
+            jnp.ones((N,), batch.edge_mask.dtype),
+        ])
 
         z = x_l[senders] + x_r[receivers]  # [E+N, H, F]
         if spec.edge_dim:
@@ -65,13 +78,24 @@ class GATConv(nn.Module):
             ea_sum = segment.segment_sum(masked_ea, batch.receivers, N)
             deg = segment.segment_sum(batch.edge_mask, batch.receivers, N)
             self_ea = ea_sum / jnp.maximum(deg, 1.0)[:, None]
-            ea = jnp.concatenate([batch.edge_attr, self_ea], axis=0)
+            ea = jnp.concatenate([
+                batch.edge_attr,
+                jnp.zeros((sl_pad,) + batch.edge_attr.shape[1:],
+                          batch.edge_attr.dtype),
+                self_ea,
+            ], axis=0)
             z = z + nn.Dense(H * F, name="lin_edge")(ea).reshape(-1, H, F)
         z = nn.leaky_relu(z, negative_slope=NEGATIVE_SLOPE)
         logits = jnp.einsum("ehf,hf->eh", z, att)
         # mask padded edges out of the softmax
         logits = jnp.where(e_mask[:, None] > 0, logits, -1e9)
-        alpha = segment.segment_softmax(logits, receivers, N)  # [E+N, H]
+        # collate certifies this exact extended-receivers layout for the
+        # fused segment-softmax kernel (BatchMeta.attn_fits); seg_hint can't
+        # resolve it (new array), so the certificate rides explicitly
+        attn_fits = batch.meta.attn_fits if batch.meta is not None else None
+        alpha = segment.segment_softmax(
+            logits, receivers, N, hints=batch, fits=attn_fits
+        )  # [E+pad+N, H]
         alpha = alpha * e_mask[:, None]
         # attention-coefficient dropout (reference GATv2Conv dropout=0.25)
         alpha = nn.Dropout(rate=self.spec.dropout, name="attn_drop")(

@@ -76,8 +76,20 @@ class GraphMultiheadAttention(nn.Module):
         qd, kd, vd = to_dense(q), to_dense(k), to_dense(v)
         valid = jnp.arange(n_max)[None, :] < batch.n_node[:, None]  # [G, n_max]
         logits = jnp.einsum("gnhd,gmhd->ghnm", qd, kd) / jnp.sqrt(float(Dh))
-        logits = jnp.where(valid[:, None, None, :], logits, -1e9)
-        attn = jax.nn.softmax(logits, axis=-1)
+        # the dense-block path itself is chosen at trace time off the
+        # collate-certified bound (batch.meta.max_n_node below); the fused
+        # kernel collapses its mask→max→exp→sum→divide per-row chain into
+        # one Pallas pass (A/B: HYDRAGNN_FUSED_SOFTMAX, exact — rows are
+        # independent, so no layout contract / fallback cond is needed)
+        from ..ops import fused_softmax
+
+        if fused_softmax._auto_enabled():
+            attn = fused_softmax.fused_masked_softmax(
+                logits, valid[:, None, None, :]
+            )
+        else:
+            logits = jnp.where(valid[:, None, None, :], logits, -1e9)
+            attn = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("ghnm,gmhd->gnhd", attn, vd)
         return out[gid, slot] * batch.node_mask[:, None, None]
 

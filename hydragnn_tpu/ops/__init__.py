@@ -1,5 +1,23 @@
-"""TPU Pallas kernels for the message-passing hot path."""
+"""TPU Pallas kernel library for the message-passing / MD / serving hot
+paths. One playbook per kernel (see ``fused_scatter``): receiver-sorted
+windows + scalar prefetch, collate-certified geometry where a layout
+contract exists, an in-program (or static) XLA fallback, and
+``interpret=True`` CPU testability behind a ``HYDRAGNN_*`` A/B flag."""
 
-from .fused_scatter import fused_gather_scatter, gather_scatter_sum
+from .fused_cell_list import fused_binned_radius_graph  # noqa: F401
+from .fused_scatter import fused_gather_scatter, gather_scatter_sum  # noqa: F401
+from .fused_softmax import (  # noqa: F401
+    fused_masked_softmax,
+    fused_segment_softmax,
+)
+from .quant_matmul import quant_dense, quantize_weight  # noqa: F401
 
-__all__ = ["fused_gather_scatter", "gather_scatter_sum"]
+__all__ = [
+    "fused_binned_radius_graph",
+    "fused_gather_scatter",
+    "fused_masked_softmax",
+    "fused_segment_softmax",
+    "gather_scatter_sum",
+    "quant_dense",
+    "quantize_weight",
+]

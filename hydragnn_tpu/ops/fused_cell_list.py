@@ -1,0 +1,282 @@
+"""Fused cell-list neighbor build: the MD graph-rebuild hot op.
+
+``md.binned_radius_graph`` (the on-device vesin role) is pure XLA today: it
+gathers every atom's 27-cell candidate set into a ``[n, 27*capacity]`` id
+matrix, gathers candidate positions ``[n, C, 3]``, and materializes
+displacement/shift/distance matrices of the same extent in HBM before the
+distance filter — ~20+ bytes per candidate round-tripped per MD step. This
+kernel runs the candidate walk → min-image displacement → distance filter
+INSIDE one Pallas pass over cell-sorted atoms, so the only candidate-extent
+array that ever reaches HBM is the final 1-byte hit mask.
+
+Geometry (the ``fused_scatter`` playbook, adapted to cells):
+
+* atoms are sorted by cell id (XLA prelude — sort stays outside the kernel);
+  every cell's atoms then form one contiguous run of the sorted array;
+* grid = one program per cell. The program's central atoms and each of its
+  27 neighbor-cell candidate runs are fixed-width ``W`` windows into the
+  sorted position array (``W`` = capacity rounded for 8-aligned starts);
+  the 27 × (start, first, count) window descriptors ride scalar prefetch,
+  and exact run membership is recovered in-kernel by comparing window
+  offsets against (first, count) — clamping/alignment can therefore never
+  admit a wrong atom or drop a real one;
+* the kernel emits the ``[cells, W, 27·W]`` int8 hit mask; a thin XLA
+  epilogue decodes hit coordinates back to sorted indices arithmetically
+  (cell/slot/window math — no candidate id matrix is ever built), maps them
+  through the sort order, and recomputes the per-edge PBC shift for just the
+  selected pairs.
+
+Semantics are edge-for-edge identical to the XLA build (same binning, same
+min-image formula, same self-exclusion, same ``max_edges`` truncation
+telltale and capacity-overflow poisoning of ``n_edges``) except EDGE ORDER:
+hits stream out cell-major instead of atom-major. Every consumer
+(``energy_fn`` segment sums) is order-insensitive up to fp association, and
+the parity tests compare edge SETS plus end-to-end energies.
+
+The build's outputs carry no useful position gradients (ids are integers;
+shifts are piecewise-constant in ``pos``, gradient 0 — same as the XLA
+path), so kernel inputs are ``stop_gradient``-wrapped and the epilogue's
+differentiable shift recompute preserves the XLA path's (zero) gradient
+structure exactly.
+
+A/B switch: ``HYDRAGNN_FUSED_CELL_LIST=0|1``; default on for TPU backends,
+off (but testable via ``interpret=True``) elsewhere. Statically ineligible
+geometries (tiny systems, VMEM/SMEM budget) return ``None`` and the caller
+keeps the XLA path — correctness never depends on the kernel running.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable without TPU; interpret mode runs anywhere
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+Array = jax.Array
+
+# resident sorted positions + per-j [W, W, 3] displacement block budget
+_VMEM_RESIDENT_LIMIT = 10 * 1024 * 1024
+# the 6 scalar-prefetch descriptor arrays are O(cells·27) SMEM ints; cap the
+# cell count so their footprint stays bounded (beyond this the XLA path is
+# memory-bound anyway and atoms should shard over the mesh first)
+_MAX_CELLS = 8192
+
+
+def _flag_enabled() -> bool | None:
+    from ..utils import flags
+
+    return flags.get(flags.FUSED_CELL_LIST)
+
+
+def _auto_enabled() -> bool:
+    flag = _flag_enabled()
+    if flag is not None:
+        return flag
+    return jax.default_backend() == "tpu"
+
+
+def cell_window(capacity: int) -> int:
+    """Window width per cell run: ``capacity`` atoms plus slack for the
+    8-aligned start (a clamped-down start can sit up to 7 rows early)."""
+    return int(-(-(capacity + 7) // 8) * 8)
+
+
+def _cell_kernel(
+    cstart_ref,   # SMEM [cells] central window start (8-aligned, clamped)
+    cfirst_ref,   # SMEM [cells] first sorted index of the central run
+    ccount_ref,   # SMEM [cells] central run length
+    nstart_ref,   # SMEM [cells*27] neighbor window starts
+    nfirst_ref,   # SMEM [cells*27] neighbor run firsts
+    ncount_ref,   # SMEM [cells*27] neighbor run lengths (0 = invalid cell)
+    spos_ref,     # VMEM [n, 3] cell-sorted positions, resident
+    cellm_ref,    # VMEM [3, 3] cell matrix
+    inv_ref,      # VMEM [3, 3] inverse cell matrix
+    pbc_ref,      # VMEM [1, 3] periodic-axis mask (1.0 / 0.0)
+    out_ref,      # VMEM [1, W, 27*W] int8 hit mask block for this cell
+    *,
+    window: int,
+    cutoff2: float,
+):
+    c = pl.program_id(0)
+    w = window
+    cellm = cellm_ref[...].astype(jnp.float32)
+    inv = inv_ref[...].astype(jnp.float32)
+    pbcf = pbc_ref[0, :].astype(jnp.float32)  # [3]
+
+    c0 = cstart_ref[c]
+    catoms = spos_ref[pl.ds(c0, w), :].astype(jnp.float32)  # [W, 3]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (w,), 0)
+    cidx = c0 + lane
+    cvalid = (cidx >= cfirst_ref[c]) & (cidx < cfirst_ref[c] + ccount_ref[c])
+
+    for j in range(27):
+        s0 = nstart_ref[c * 27 + j]
+        f0 = nfirst_ref[c * 27 + j]
+        ct = ncount_ref[c * 27 + j]
+        watoms = spos_ref[pl.ds(s0, w), :].astype(jnp.float32)  # [W, 3]
+        ridx = s0 + lane
+        rvalid = (ridx >= f0) & (ridx < f0 + ct)
+        disp = watoms[None, :, :] - catoms[:, None, :]  # [W, W, 3]
+        frac = jnp.dot(disp.reshape(-1, 3), inv,
+                       preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+        wrap = jnp.round(frac) * pbcf[None, :]
+        shift = -jnp.dot(wrap, cellm, preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
+        dispw = disp + shift.reshape(w, w, 3)
+        d2 = jnp.sum(dispw * dispw, axis=-1)  # [W, W]
+        within = (
+            (d2 <= cutoff2)
+            & cvalid[:, None]
+            & rvalid[None, :]
+            & (cidx[:, None] != ridx[None, :])
+        )
+        out_ref[0, :, j * w:(j + 1) * w] = within.astype(jnp.int8)
+
+
+def _static_ok(n: int, n_cells: int, window: int) -> bool:
+    if pltpu is None:
+        return False
+    if n < window or n_cells > _MAX_CELLS:
+        return False
+    if n_cells * window * 27 * window >= 2**31:  # flat nonzero index space
+        return False
+    vmem = n * 3 * 4 + 2 * window * window * 3 * 4 + window * 27 * window
+    if vmem > _VMEM_RESIDENT_LIMIT:
+        return False
+    return True
+
+
+def fused_binned_radius_graph(
+    pos: Array,
+    cutoff: float,
+    max_edges: int,
+    cell: Array,
+    pbc: Array,
+    grid: tuple[int, int, int],
+    capacity: int,
+    pad_id: int = 0,
+    interpret: bool | None = None,
+):
+    """Fused-kernel twin of ``md.binned_radius_graph`` — same arguments,
+    same ``(senders, receivers, shifts, edge_mask, n_edges)`` contract (edge
+    ORDER differs: cell-major, documented above). Returns ``None`` when the
+    static geometry checks rule the kernel out; the caller then runs the
+    XLA path. ``grid``/``capacity`` come from ``md.plan_cell_grid``."""
+    n = pos.shape[0]
+    gx, gy, gz = (int(g) for g in grid)
+    n_cells = gx * gy * gz
+    w = cell_window(int(capacity))
+    if not _static_ok(n, n_cells, w):
+        return None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    g = jnp.asarray([gx, gy, gz], jnp.int32)
+    cellm = jnp.asarray(cell, jnp.float32).reshape(3, 3)
+    inv = jnp.linalg.inv(cellm)
+    pbc_b = jnp.asarray(pbc, bool).reshape(3)
+
+    # ---- prelude (XLA): binning + sort + per-cell run/window descriptors.
+    # Bit-identical binning to the XLA build: same wrapped/clamped fractional
+    # coordinates, same cell linearization.
+    posf = pos.astype(jnp.float32)
+    frac = posf @ inv
+    fw = jnp.where(pbc_b, frac % 1.0, jnp.clip(frac, 0.0, 1.0 - 1e-9))
+    idx3 = jnp.clip((fw * g).astype(jnp.int32), 0, g - 1)
+    cid = (idx3[:, 0] * gy + idx3[:, 1]) * gz + idx3[:, 2]
+    order = jnp.argsort(cid).astype(jnp.int32)
+    spos = posf[order]
+    cs = cid[order]
+    cell_ids = jnp.arange(n_cells, dtype=cid.dtype)
+    cell_start = jnp.searchsorted(cs, cell_ids, side="left").astype(jnp.int32)
+    occ = jax.ops.segment_sum(
+        jnp.ones(n, jnp.int32), cid, num_segments=n_cells
+    )
+    max_occ = occ.max()
+
+    from ..md import _CELL_OFFSETS
+
+    coords = jnp.stack([
+        cell_ids // (gy * gz), (cell_ids // gz) % gy, cell_ids % gz,
+    ], axis=-1)  # [cells, 3]
+    offs = jnp.asarray(_CELL_OFFSETS)
+    nbr3 = coords[:, None, :] + offs[None, :, :]  # [cells, 27, 3]
+    wrapped = nbr3 % g
+    valid = (pbc_b | ((nbr3 >= 0) & (nbr3 < g))).all(-1)  # [cells, 27]
+    ncid = (wrapped[..., 0] * gy + wrapped[..., 1]) * gz + wrapped[..., 2]
+
+    firsts = cell_start[ncid]  # [cells, 27]
+    counts = jnp.where(valid, occ[ncid], 0).astype(jnp.int32)
+    hi = max(n - w, 0)
+    starts8 = jnp.clip((firsts // 8) * 8, 0, hi).astype(jnp.int32)
+    cstart8 = jnp.clip((cell_start // 8) * 8, 0, hi).astype(jnp.int32)
+
+    # ---- kernel: the candidate walk + distance filter, nothing but the
+    # int8 hit mask leaves the chip. The build carries no position gradient
+    # (ids + piecewise-constant shifts), so kernel inputs are detached —
+    # pallas_call never enters the autodiff graph.
+    sg = jax.lax.stop_gradient
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(n_cells,),
+        in_specs=[
+            pl.BlockSpec((n, 3), lambda c, *_: (0, 0)),  # spos resident
+            pl.BlockSpec((3, 3), lambda c, *_: (0, 0)),
+            pl.BlockSpec((3, 3), lambda c, *_: (0, 0)),
+            pl.BlockSpec((1, 3), lambda c, *_: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, w, 27 * w), lambda c, *_: (c, 0, 0)),
+    )
+    within = pl.pallas_call(
+        functools.partial(
+            _cell_kernel, window=w, cutoff2=float(cutoff) ** 2
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_cells, w, 27 * w), jnp.int8),
+        interpret=interpret,
+    )(
+        cstart8, cell_start, occ.astype(jnp.int32),
+        starts8.reshape(-1), firsts.reshape(-1).astype(jnp.int32),
+        counts.reshape(-1),
+        sg(spos), sg(cellm), sg(inv),
+        sg(jnp.where(pbc_b, 1.0, 0.0).reshape(1, 3).astype(jnp.float32)),
+    )
+
+    # ---- epilogue (XLA): decode hit coordinates arithmetically, map
+    # through the sort, recompute shifts for selected pairs only.
+    hits = within.reshape(-1) != 0
+    n_real = hits.sum()
+    flat_idx = jnp.nonzero(hits, size=max_edges, fill_value=0)[0]
+    c_of = (flat_idx // (w * 27 * w)).astype(jnp.int32)
+    rem = flat_idx % (w * 27 * w)
+    a_of = (rem // (27 * w)).astype(jnp.int32)
+    col = rem % (27 * w)
+    j_of = (col // w).astype(jnp.int32)
+    i_of = (col % w).astype(jnp.int32)
+    sidx = cstart8[c_of] + a_of
+    ridx = starts8[c_of, j_of] + i_of
+    senders = order[sidx]
+    receivers = order[ridx]
+    edge_mask = (jnp.arange(max_edges) < n_real).astype(pos.dtype)
+
+    disp = pos[receivers] - pos[senders]
+    wrap = jnp.round(disp @ inv.astype(pos.dtype)) * jnp.where(pbc_b, 1.0, 0.0)
+    shift = -(wrap @ cellm.astype(pos.dtype))
+    shifts = shift * edge_mask[:, None]
+    senders = jnp.where(edge_mask > 0, senders, pad_id)
+    receivers = jnp.where(edge_mask > 0, receivers, pad_id)
+    # same overflow poison as the XLA build: a cell past capacity means
+    # candidates were (or could have been) dropped — trip the caller's
+    # n_edges telltale rather than silently missing edges
+    n_edges = jnp.where(max_occ > capacity, max_edges + max_occ, n_real)
+    return senders, receivers, shifts, edge_mask, n_edges
+
+
+__all__ = ["cell_window", "fused_binned_radius_graph"]

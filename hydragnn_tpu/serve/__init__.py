@@ -21,6 +21,7 @@ from .admission import (  # noqa: F401
 )
 from .batcher import MicroBatcher, canonical_meta, serving_collate  # noqa: F401
 from .predictor import Predictor  # noqa: F401
+from .quant import QuantizationError  # noqa: F401
 from .server import (  # noqa: F401
     ModelEndpoint,
     PredictionServer,
@@ -38,6 +39,7 @@ __all__ = [
     "OversizeError",
     "PredictionServer",
     "Predictor",
+    "QuantizationError",
     "QueueFullError",
     "Request",
     "RequestQueue",

@@ -55,7 +55,7 @@ def canonical_meta(pad: PadSpec) -> BatchMeta:
         bound = max(1 << max(pad.n_node - 1, 0).bit_length(), 8)
     return BatchMeta(
         gs_fits=False, recv_fits=False, send_fits=False, pool_fits=False,
-        max_n_node=int(bound),
+        max_n_node=int(bound), attn_fits=False,
     )
 
 

@@ -1,0 +1,178 @@
+"""Int8 inference quantization for the serving tier.
+
+PR 6 named this follow-up: serving is memory-bound at the weight fetch, so
+an int8 predict variant (4× fewer weight bytes, int8×int8 MXU matmuls via
+``ops.quant_matmul``) buys bucket throughput — IF its error is bounded and
+certified, never assumed. The pieces:
+
+- **calibration** (:func:`collect_activation_scales`): eager forward passes
+  over per-bucket calibration traffic with a flax method interceptor
+  recording every ``nn.Dense`` input's abs-max — one activation scale per
+  (layer, model, bucket). Eager on purpose: calibration is a boot-time
+  observation pass, not a compiled hot path.
+- **weight quantization** (:func:`quantize_dense_weights`): symmetric
+  per-output-channel int8 for every calibrated Dense kernel; every other
+  parameter (biases, norms, embeddings, equivariant tensors) stays fp32.
+- **the quantized step** (:func:`make_quantized_predict_step`): the SAME
+  ``model.apply`` as the fp32 predict step, with an interceptor swapping
+  each calibrated Dense for ``ops.quant_matmul.quant_dense`` at trace time
+  — int8 weights ride the executable as constants, scales are compile-time
+  per bucket, so the step AOT-compiles into the endpoint's warm table
+  exactly like the fp32 one.
+- **error certification** (:func:`certify_quant_error`): per-head max
+  abs deviation of the quantized answers from the fp32 answers on the
+  calibration batches (real rows only). The measured bounds are the
+  endpoint's contract; any head's bound above ``Serving.quant_tol`` raises
+  :class:`QuantizationError` at warm-up — a model that quantizes badly
+  refuses to serve quantized rather than quietly degrading.
+
+The fp32 path is untouched: endpoints keep their fp32 executables, and with
+``Serving.quantize`` off (the default) nothing here runs.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quant_matmul import quant_dense, quantize_weight
+from ..train.step import _cast_floats
+
+
+class QuantizationError(RuntimeError):
+    """A head's calibrated int8 error exceeds ``Serving.quant_tol``."""
+
+
+def _apply(model, state, batch, compute_dtype, interceptor=None):
+    import flax.linen as nn
+
+    c_params = _cast_floats(state.params, compute_dtype)
+    c_batch = _cast_floats(batch, compute_dtype)
+    variables = {"params": c_params, "batch_stats": state.batch_stats}
+    if interceptor is None:
+        return model.apply(variables, c_batch, train=False)
+    with nn.intercept_methods(interceptor):
+        return model.apply(variables, c_batch, train=False)
+
+
+def collect_activation_scales(
+    model, state, batches: Sequence, compute_dtype=jnp.float32
+) -> dict[str, float]:
+    """Per-``nn.Dense`` activation scales (abs-max / 127) observed over
+    ``batches`` — keys are module paths ("conv_0/lin_l", ...)."""
+    import flax.linen as nn
+
+    absmax: dict[str, float] = {}
+
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if isinstance(mod, nn.Dense) and context.method_name == "__call__":
+            path = "/".join(mod.path)
+            x = np.asarray(args[0], np.float32)
+            cur = float(np.max(np.abs(x))) if x.size else 0.0
+            absmax[path] = max(absmax.get(path, 0.0), cur)
+        return next_fun(*args, **kwargs)
+
+    for batch in batches:
+        _apply(model, state, batch, compute_dtype, interceptor)
+    return {p: max(a, 1e-8) / 127.0 for p, a in absmax.items()}
+
+
+def quantize_dense_weights(params, scales: Mapping[str, float]) -> dict:
+    """int8-quantize every Dense kernel named by ``scales``. Returns
+    ``{path: (w_q int8, s_w fp32, bias | None)}``; everything else is left
+    to the fp32 parameter tree."""
+    table: dict[str, tuple] = {}
+
+    def walk(tree, prefix):
+        if not isinstance(tree, Mapping):
+            return
+        kernel = tree.get("kernel")
+        path = "/".join(prefix)
+        if (
+            kernel is not None
+            and path in scales
+            and getattr(kernel, "ndim", 0) == 2
+        ):
+            w_q, s_w = quantize_weight(jnp.asarray(kernel, jnp.float32))
+            bias = tree.get("bias")
+            table[path] = (
+                w_q, s_w,
+                None if bias is None else jnp.asarray(bias, jnp.float32),
+            )
+        for key, val in tree.items():
+            if isinstance(val, Mapping):
+                walk(val, prefix + (key,))
+
+    walk(params, ())
+    return table
+
+
+def make_quantized_predict_step(
+    model, scales: Mapping[str, float], weights: Mapping[str, tuple],
+    compute_dtype=jnp.float32, use_kernel: bool | None = None,
+):
+    """``(state, batch) -> per-head predictions`` with every calibrated
+    Dense computed int8. Same signature as ``make_predict_step`` so it AOT
+    compiles and serves through the identical endpoint machinery. The int8
+    weights are trace-time constants: the ``state`` argument still feeds
+    every non-quantized parameter (norms, embeddings, head biases)."""
+    import flax.linen as nn
+
+    def q_interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if isinstance(mod, nn.Dense) and context.method_name == "__call__":
+            path = "/".join(mod.path)
+            ent = weights.get(path)
+            s_x = scales.get(path)
+            if ent is not None and s_x is not None:
+                w_q, s_w, bias = ent
+                x = args[0]
+                x2 = x.reshape(-1, x.shape[-1])
+                y = quant_dense(
+                    x2, w_q, s_w, s_x,
+                    bias if mod.use_bias else None, kernel=use_kernel,
+                )
+                return y.reshape(x.shape[:-1] + (w_q.shape[1],)).astype(
+                    x.dtype
+                )
+        return next_fun(*args, **kwargs)
+
+    @jax.jit
+    def quant_predict_step(state, batch):
+        out = _apply(model, state, batch, compute_dtype, q_interceptor)
+        return _cast_floats(out, jnp.float32)
+
+    return quant_predict_step
+
+
+def certify_quant_error(
+    predictor, quant_step, batches: Sequence
+) -> list[float]:
+    """Per-head max abs deviation |int8 − fp32| over the REAL rows of the
+    calibration ``batches`` — the bounds the endpoint certifies (and
+    ``Serving.quant_tol`` gates) at warm-up."""
+    bounds = [0.0] * len(predictor.cols)
+    for batch in batches:
+        ref = predictor.outputs(batch)
+        q = predictor.outputs(batch, step=quant_step)
+        _, ref_rows = predictor.gather(batch, out=ref)
+        _, q_rows = predictor.gather(batch, out=q)
+        for ihead, (r, p) in enumerate(zip(ref_rows, q_rows)):
+            if r.size:
+                bounds[ihead] = max(
+                    bounds[ihead], float(np.max(np.abs(r - p)))
+                )
+    return bounds
+
+
+__all__ = [
+    "QuantizationError",
+    "certify_quant_error",
+    "collect_activation_scales",
+    "make_quantized_predict_step",
+    "quantize_dense_weights",
+]

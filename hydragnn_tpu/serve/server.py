@@ -25,6 +25,7 @@ overridden by ``HYDRAGNN_SERVE_*`` env flags (``utils.flags``).
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -32,8 +33,10 @@ from typing import Sequence
 
 import numpy as np
 
-from ..graphs.batching import PadSpec, compute_pad_buckets
+from ..graphs.batching import PadSpec, compute_pad_buckets, pick_bucket
 from ..graphs.graph import GraphSample
+
+_EMPTY = np.zeros((0,), np.int32)  # triplet default for extras-less samples
 from ..train.step import TrainState
 from ..utils import flags
 from .admission import (
@@ -52,7 +55,7 @@ from .predictor import Predictor
 # tell "full config without a Serving block" (defaults) apart from "typo'd
 # serving block" (raise)
 _CONFIG_SECTIONS = frozenset(
-    {"Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving"}
+    {"Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving", "MD"}
 )
 
 
@@ -68,6 +71,13 @@ class ServingConfig:
     warmup: bool = True      # AOT-compile every bucket executable at boot
     max_batch_graphs: int = 0  # per-batch request cap (0 = bucket capacity)
     deadline_ms: float = 0.0   # default per-request deadline (0 = none)
+    # int8 inference (serve.quant): calibrate per-(model, bucket) activation
+    # scales at warm-up, AOT-compile an int8 predict variant alongside fp32,
+    # and serve it — REFUSING to boot if any head's calibrated error vs the
+    # fp32 answer exceeds quant_tol (QuantizationError)
+    quantize: bool = False
+    quant_tol: float = 0.1       # per-head max abs error ceiling vs fp32
+    quant_calib_batches: int = 4  # calibration batches per (model, bucket)
 
     @staticmethod
     def from_config(config: dict | None) -> "ServingConfig":
@@ -104,6 +114,9 @@ class ServingConfig:
         warm = flags.get(flags.SERVE_WARMUP)
         if warm is not None:
             self.warmup = bool(warm)
+        quant = flags.get(flags.SERVE_QUANT)
+        if quant is not None:
+            self.quantize = bool(quant)
         return self
 
     def validate(self) -> "ServingConfig":
@@ -123,6 +136,21 @@ class ServingConfig:
             raise ValueError(
                 "Serving.max_batch_graphs must be >= 0 (0 = bucket "
                 f"capacity), got {self.max_batch_graphs}"
+            )
+        if float(self.quant_tol) <= 0:
+            raise ValueError(
+                f"Serving.quant_tol must be > 0, got {self.quant_tol}"
+            )
+        if int(self.quant_calib_batches) < 1:
+            raise ValueError(
+                "Serving.quant_calib_batches must be >= 1, got "
+                f"{self.quant_calib_batches}"
+            )
+        if self.quantize and not self.warmup:
+            raise ValueError(
+                "Serving.quantize requires Serving.warmup: calibration and "
+                "the error-bound gate run at warm-up — without it the "
+                "server would silently serve fp32 despite quantize=true"
             )
         return self
 
@@ -159,7 +187,8 @@ class ModelEndpoint:
 
     def __init__(self, name: str, predictor: Predictor,
                  buckets: Sequence[PadSpec], example: GraphSample,
-                 cfg: ServingConfig, denormalize: bool = False):
+                 cfg: ServingConfig, denormalize: bool = False,
+                 calib_samples: Sequence[GraphSample] | None = None):
         self.name = name
         self.predictor = predictor
         self.buckets = sorted(buckets, key=lambda p: p.as_tuple())
@@ -167,6 +196,11 @@ class ModelEndpoint:
         self.cfg = cfg
         self.denormalize = denormalize
         self.executables: dict[tuple, object] = {}
+        # int8 variants (cfg.quantize): one quantized executable per bucket,
+        # compiled ALONGSIDE the fp32 table — never instead of it
+        self.executables_quant: dict[tuple, object] = {}
+        self.quant_bounds: list[float] | None = None  # per-head, calibrated
+        self.calib_samples = list(calib_samples) if calib_samples else [example]
         self.thread: threading.Thread | None = None
         self._lock = threading.Lock()
         self.counters = {
@@ -259,15 +293,102 @@ class ModelEndpoint:
                 shape_structs(batch),
             )
             report[repr(pad)] = round(time.perf_counter() - t0, 4)
+        if self.cfg.quantize:
+            report["quant"] = self.warm_quant()
         if verify:
             with no_recompile(0, what=f"serving warm-up verify [{self.name}]"):
                 for pad in self.buckets:
                     self.executables[pad.as_tuple()](
                         self.predictor.state, serving_collate([dummy], pad)
                     )
+                for pad in self.buckets:
+                    exe = self.executables_quant.get(pad.as_tuple())
+                    if exe is not None:
+                        exe(self.predictor.state, serving_collate([dummy], pad))
+        return report
+
+    def warm_quant(self) -> dict:
+        """The int8 half of warm-up (``serve.quant``): per-bucket activation
+        calibration over this endpoint's calibration samples, one quantized
+        executable per bucket AOT-compiled next to the fp32 one, and per-head
+        error bounds certified against the fp32 answers — above
+        ``Serving.quant_tol`` this RAISES instead of serving degraded
+        answers. Returns the warm-up report (scales/bounds/compile s)."""
+        from ..utils.compile_cache import aot_compile, shape_structs
+        from .quant import (
+            QuantizationError,
+            certify_quant_error,
+            collect_activation_scales,
+            make_quantized_predict_step,
+            quantize_dense_weights,
+        )
+
+        pred = self.predictor
+        report: dict = {"buckets": {}}
+        bounds = [0.0] * len(pred.cols)
+        k = max(int(self.cfg.quant_calib_batches), 1)
+        for pad in self.buckets:
+            # calibration traffic for THIS bucket: the largest calibration
+            # samples the bucket admits, collated exactly as serving would
+            fitting = [
+                s for s in self.calib_samples
+                if pick_bucket([pad], s.num_nodes, s.num_edges,
+                               s.extras.get("idx_kj", _EMPTY).shape[0], 1)
+            ]
+            if not fitting:
+                # certifying on a synthetic dummy would produce ~0 "bounds"
+                # that say nothing about real traffic — the whole contract
+                # is "bounded and certified, never assumed", so refuse
+                raise QuantizationError(
+                    f"endpoint {self.name!r}: no calibration sample fits "
+                    f"bucket {pad!r} — pass `samples` covering every "
+                    "bucket to add_model (or drop the bucket) before "
+                    "enabling Serving.quantize"
+                )
+            batches = [
+                serving_collate([s], pad)
+                for s in sorted(fitting, key=lambda s: -s.num_nodes)[:k]
+            ]
+            scales = collect_activation_scales(
+                pred.model, pred.state, batches, pred.compute_dtype
+            )
+            weights = quantize_dense_weights(pred.state.params, scales)
+            q_step = make_quantized_predict_step(
+                pred.model, scales, weights, pred.compute_dtype
+            )
+            t0 = time.perf_counter()
+            exe = aot_compile(q_step, pred.state, shape_structs(batches[0]))
+            pad_bounds = certify_quant_error(pred, exe, batches)
+            bounds = [max(a, b) for a, b in zip(bounds, pad_bounds)]
+            self.executables_quant[pad.as_tuple()] = exe
+            report["buckets"][repr(pad)] = {
+                "compile_s": round(time.perf_counter() - t0, 4),
+                "n_dense_layers": len(weights),
+                "error_bounds": [round(b, 6) for b in pad_bounds],
+            }
+        report["error_bounds"] = [round(b, 6) for b in bounds]
+        report["quant_tol"] = self.cfg.quant_tol
+        self.quant_bounds = bounds
+        over = [
+            (i, b) for i, b in enumerate(bounds) if b > self.cfg.quant_tol
+        ]
+        if over:
+            self.executables_quant.clear()
+            self.quant_bounds = None
+            raise QuantizationError(
+                f"endpoint {self.name!r}: calibrated int8 error exceeds "
+                f"Serving.quant_tol={self.cfg.quant_tol} for head(s) "
+                f"{[(i, round(b, 6)) for i, b in over]} — serve fp32 "
+                "(quantize=false) or raise quant_tol if the error is "
+                "acceptable for this model"
+            )
         return report
 
     def _step_for(self, pad: PadSpec):
+        if self.cfg.quantize:
+            exe = self.executables_quant.get(pad.as_tuple())
+            if exe is not None:
+                return exe
         exe = self.executables.get(pad.as_tuple())
         # warmup=False endpoints lazily fall back to the jitted step: first
         # use of a (bucket) treedef compiles, steady state then hits the jit
@@ -401,9 +522,64 @@ class PredictionServer:
         )
         predictor = Predictor(model, state, config, donate_batch=True)
         ep = ModelEndpoint(name, predictor, buckets, example, cfg,
-                           denormalize=denormalize)
+                           denormalize=denormalize, calib_samples=samples)
         self._models[name] = ep
         return ep
+
+    def add_model_from_checkpoint(
+        self,
+        name: str,
+        log_name: str,
+        path: str = "./logs/",
+        config: dict | None = None,
+        samples: Sequence[GraphSample] | None = None,
+        epoch: int | None = None,
+        **add_model_kwargs,
+    ) -> ModelEndpoint:
+        """Register a servable model straight from a training run's
+        checkpoint directory — the PR 6 follow-up (callers previously had
+        to reconstruct model+state themselves). ``config`` defaults to the
+        AUGMENTED ``config.json`` ``save_config`` wrote next to the run's
+        logs; the model/optimizer/state template are rebuilt from it and
+        the newest (or ``epoch``-pinned) checkpoint is restored into it.
+        ``samples`` provide the bucket table + feature signature, exactly
+        as in :meth:`add_model`."""
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from ..config.schema import load_config
+        from ..graphs.batching import collate, compute_pad_spec
+        from ..models.create import create_model_config
+        from ..train.checkpoint import load_checkpoint
+        from ..train.optimizer import select_optimizer
+        from ..train.step import create_train_state
+
+        if config is None:
+            config = load_config(os.path.join(path, log_name, "config.json"))
+        if not samples:
+            raise ValueError(
+                "add_model_from_checkpoint needs `samples` to derive the "
+                "bucket table and the state template's batch shapes"
+            )
+        model = create_model_config(config)
+        opt = select_optimizer(
+            config["NeuralNetwork"]["Training"]["Optimizer"]
+        )
+        bs = int(
+            add_model_kwargs.get("batch_size")
+            or config["NeuralNetwork"]["Training"].get("batch_size", 32)
+        )
+        probe = list(samples[: max(1, min(len(samples), bs))])
+        pad = compute_pad_spec(probe, len(probe))
+        template = create_train_state(
+            model, opt, _jax.tree.map(_jnp.asarray, collate(probe, pad))
+        )
+        state, _meta = load_checkpoint(
+            template, log_name, path=path, epoch=epoch
+        )
+        return self.add_model(
+            name, model, state, config, samples=samples, **add_model_kwargs
+        )
 
     def warmup(self, verify: bool = True) -> dict:
         """Boot-time compile of every (model, bucket) executable. The
@@ -429,6 +605,12 @@ class PredictionServer:
             for ep in self._models.values():
                 if not ep.executables:
                     ep.warm(verify=False)
+                elif ep.cfg.quantize and not ep.executables_quant:
+                    # fp32 table warm but the quant half missing (e.g. a
+                    # caught QuantizationError from an earlier warmup()):
+                    # re-run the quant warm so start() either serves REAL
+                    # int8 or fails loudly — never quantize=true-but-fp32
+                    ep.warm_quant()
         self._stopping = False
         for ep in self._models.values():
             if ep.queue.closed:  # restart after stop(): re-arm the queue
@@ -528,6 +710,12 @@ class PredictionServer:
             c["queue_depth"] = len(ep.queue)
             c["buckets"] = [b.as_tuple() for b in ep.buckets]
             c["warm_executables"] = len(ep.executables)
+            c["quantized"] = bool(
+                ep.cfg.quantize and ep.executables_quant
+            )
+            c["quant_executables"] = len(ep.executables_quant)
+            if ep.quant_bounds is not None:
+                c["quant_bounds"] = [round(b, 6) for b in ep.quant_bounds]
             c["occupancy"] = round(
                 c["real_graph_slots"] / c["graph_slots"], 4
             ) if c["graph_slots"] else None

@@ -167,12 +167,36 @@ SERVE_WARMUP = _register(Flag(
     "first use — first requests then pay the compile the warm-up was "
     "built to hide; the strict zero-recompile guarantee only holds for "
     "warmed endpoints."))
+SERVE_QUANT = _register(Flag(
+    "HYDRAGNN_SERVE_QUANT", "bool", None,
+    "Serve int8-quantized predictions (overrides Serving.quantize, default "
+    "off). Warm-up then calibrates per-(model, bucket) activation scales "
+    "from the endpoint's calibration samples, AOT-compiles an int8 predict "
+    "variant ALONGSIDE the fp32 one, and refuses to boot if any head's "
+    "calibrated error vs the fp32 answer exceeds Serving.quant_tol. =0 "
+    "serves the fp32 executables only (bit-identical to run_prediction)."))
 
 # -- kernels / compilation --------------------------------------------------
 FUSED_SCATTER = _register(Flag(
     "HYDRAGNN_FUSED_SCATTER", "bool", None,
     "Force the Pallas fused gather-scatter kernel on/off (default: on for "
     "TPU backends)."))
+FUSED_SOFTMAX = _register(Flag(
+    "HYDRAGNN_FUSED_SOFTMAX", "bool", None,
+    "Force the Pallas fused segment-softmax kernel on/off (default: on for "
+    "TPU backends). Collapses segment_softmax's max->exp->sum->divide chain "
+    "(four segment ops, three HBM round-trips of [E, H] intermediates) into "
+    "one windowed pass (ops/fused_softmax.py); GAT attention and the GPS "
+    "dense per-graph softmax route through it. =0 restores the XLA chain "
+    "everywhere."))
+FUSED_CELL_LIST = _register(Flag(
+    "HYDRAGNN_FUSED_CELL_LIST", "bool", None,
+    "Force the Pallas fused cell-list neighbor-build kernel on/off "
+    "(default: on for TPU backends). md.py's binned radius graph then "
+    "filters candidate pairs inside one windowed kernel over cell-sorted "
+    "atoms (ops/fused_cell_list.py) instead of materializing the full "
+    "[n, 27*capacity] candidate/displacement matrices in HBM. =0 restores "
+    "the pure-XLA binned build."))
 NATIVE = _register(Flag(
     "HYDRAGNN_NATIVE", "bool", True,
     "Use the native C++ cell-list/gather library (=0 for numpy fallback)."))

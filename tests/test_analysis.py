@@ -143,6 +143,16 @@ def test_explicit_missing_baseline_is_an_error():
 # -- baseline machinery ------------------------------------------------------
 
 
+def test_pallas_kernel_wrappers_are_clean():
+    """The ops/ kernel-wrapper playbook (host-read A/B flag, static
+    certificate routing, one in-program lax.cond fallback, pallas_call
+    built per trace) is sanctioned: every rule must stay silent on it —
+    PR 10's kernels (fused_softmax, fused_cell_list, quant_matmul) all
+    follow this exact shape."""
+    findings = analyze([str(FIXTURES / "pallas_wrappers_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_gl003_scan_folded_steps_are_clean():
     """lax.scan-folded supersteps (train/superstep.py's pattern: one jitted
     scan built outside the loop, dispatched per block) are the sanctioned

@@ -37,6 +37,9 @@ def test_example_qm9():
     assert "RMSE" in out
 
 
+@pytest.mark.slow  # r12 tier-1 budget: LJ dataset + training covered by
+#   test_md/test_forces/test_fused_cell_list; qm9/mptrj/oc20 keep the
+#   dataset-driver canary role
 def test_example_lennard_jones():
     out = run_example(
         ["examples/LennardJones/LennardJones.py", "--epochs", "3", "--configs", "30"]
@@ -44,6 +47,8 @@ def test_example_lennard_jones():
     assert "force RMSE" in out
 
 
+@pytest.mark.slow  # r12 tier-1 budget: forces pipeline covered by
+#   test_forces + mlip suites; md17 loader exercised in the slow tier
 def test_example_md17():
     out = run_example(
         ["examples/md17/md17.py", "--epochs", "2", "--frames", "40", "--arch", "PAINN"]
@@ -51,6 +56,8 @@ def test_example_md17():
     assert "energy RMSE" in out
 
 
+@pytest.mark.slow  # r12 tier-1 budget: generator+training path shares
+#   every stage with the remaining non-slow drivers
 def test_example_ising():
     out = run_example(
         ["examples/ising_model/ising.py", "--epochs", "3", "--configs", "40"]
@@ -75,6 +82,9 @@ def test_example_multibranch():
     assert "epoch 1" in out
 
 
+@pytest.mark.slow  # r12 tier-1 budget: packed store + multidataset
+#   covered by test_datasets/test_multibranch; the multi-format driver
+#   role stays with qm9/mptrj/oc20
 def test_example_multidataset_packed(tmp_path):
     """GFM-style driver: synthesize per-branch packed stores, then train
     from them with --multi (the open_*/mptrj driver pattern)."""
@@ -189,6 +199,8 @@ def test_example_qm9_hpo_parallel_trials(tmp_path):
     assert overlap, f"no two trials overlapped: {spans}"
 
 
+@pytest.mark.slow  # r12 tier-1 budget: MD rollout covered by test_md +
+#   test_fused_cell_list; the big-lattice variant was already slow
 def test_example_md_rollout():
     """Train an MLIP, then roll on-device MD with it (beyond the reference:
     graph rebuild + forward + grad forces + Verlet in one compiled step)."""

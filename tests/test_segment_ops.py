@@ -121,3 +121,137 @@ def test_certified_segment_sum_parity_at_production_size(monkeypatch):
         np.asarray(jax.grad(fused)(msg)), np.asarray(jax.grad(ref)(msg)),
         rtol=1e-5, atol=1e-5,
     )
+
+
+# -- full parity suite: every segment op vs the plain jax.ops reference ------
+#
+# Production-size layouts under BOTH kernel flags (fused scatter + fused
+# softmax in interpret mode on CPU, and disabled), pinning the edge cases the
+# unit tests above don't: empty segments inside the range, the reserved
+# dummy-pad segment absorbing masked rows, and single-edge receivers (a
+# segment whose softmax must be exactly 1.0 and whose std is exactly eps).
+
+import jax
+import pytest
+
+
+def _layout(kind, n=512, e=1024, h=8, seed=11):
+    """(data [e, h], ids [e], n) for one id-layout edge case."""
+    rng = np.random.default_rng(seed)
+    data = rng.normal(size=(e, h)).astype(np.float32)
+    if kind == "collate":
+        # sorted ids over [0, n-1) with a masked pad tail wired to the
+        # reserved dummy segment n-1 (zero data — collate's convention)
+        real = int(e * 0.8)
+        ids = np.concatenate([
+            np.sort(rng.integers(0, n - 1, size=real)),
+            np.full(e - real, n - 1),
+        ]).astype(np.int32)
+        data[real:] = 0.0
+    elif kind == "empty_segments":
+        # every other segment empty, none past n//2 touched
+        ids = np.sort(rng.choice(np.arange(0, n // 2, 2), size=e)).astype(np.int32)
+    elif kind == "single_edge_receivers":
+        # a strict permutation prefix: every touched segment has EXACTLY one
+        # row (softmax must be exactly one, mean == the row itself)
+        assert e <= n
+        ids = np.sort(rng.choice(n - 1, size=e, replace=False)).astype(np.int32)
+    else:
+        raise AssertionError(kind)
+    return jnp.asarray(data), jnp.asarray(ids), n
+
+
+_OPS = {
+    "sum": lambda d, i, n: segment.segment_sum(d, i, n),
+    "mean": lambda d, i, n: segment.segment_mean(d, i, n),
+    "max": lambda d, i, n: segment.segment_max(d, i, n),
+    "min": lambda d, i, n: segment.segment_min(d, i, n),
+    "std": lambda d, i, n: segment.segment_std(d, i, n),
+    "softmax": lambda d, i, n: segment.segment_softmax(d, i, n),
+    "normalize": lambda d, i, n: segment.segment_normalize(jnp.abs(d) + 0.1, i, n),
+    "count": lambda d, i, n: segment.segment_count(i, n),
+    "degree": lambda d, i, n: segment.scatter_degree(i, n),
+    "pool_add": lambda d, i, n: segment.global_pool("add", d, i, n),
+}
+
+
+def _reference(op, d, i, n):
+    """The plain jax.ops expression for each op (flag-independent)."""
+    if op == "sum" or op == "pool_add":
+        return jax.ops.segment_sum(d, i, num_segments=n)
+    if op == "mean":
+        tot = jax.ops.segment_sum(d, i, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(i.shape[0], jnp.float32), i, num_segments=n)
+        return tot / jnp.maximum(cnt, 1e-12)[:, None]
+    if op == "max":
+        out = jax.ops.segment_max(d, i, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    if op == "min":
+        out = jax.ops.segment_min(d, i, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    if op == "std":
+        mean = _reference("mean", d, i, n)
+        mean_sq = _reference("mean", d * d, i, n)
+        return jnp.sqrt(jnp.maximum(mean_sq - mean * mean, 0.0) + 1e-5)
+    if op == "softmax":
+        mx = jax.ops.segment_max(jax.lax.stop_gradient(d), i, num_segments=n)
+        mx = jnp.where(jnp.isfinite(mx), mx, 0.0)
+        ex = jnp.exp(d - mx[i])
+        den = jnp.maximum(jax.ops.segment_sum(ex, i, num_segments=n), 1e-12)
+        return ex / den[i]
+    if op == "normalize":
+        dd = jnp.abs(d) + 0.1
+        den = jax.ops.segment_sum(dd, i, num_segments=n)
+        den = jnp.where(jnp.abs(den) < 1e-12, 1.0, den)
+        return dd / den[i]
+    if op in ("count", "degree"):
+        return jax.ops.segment_sum(jnp.ones(i.shape[0], jnp.float32), i, num_segments=n)
+    raise AssertionError(op)
+
+
+@pytest.mark.parametrize("fused", ["0", "1"])
+@pytest.mark.parametrize(
+    "layout", ["collate", "empty_segments", "single_edge_receivers"]
+)
+@pytest.mark.parametrize("op", sorted(_OPS))
+def test_segment_op_parity_suite(op, layout, fused, monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_FUSED_SCATTER", fused)
+    monkeypatch.setenv("HYDRAGNN_FUSED_SOFTMAX", fused)
+    e = 384 if layout == "single_edge_receivers" else 1024
+    d, i, n = _layout(layout, e=e)
+    got = np.asarray(_OPS[op](d, i, n))
+    want = np.asarray(_reference(op, d, i, n))
+    assert got.shape == want.shape
+    if layout == "collate" and op in ("softmax", "normalize"):
+        # the dummy-pad segment (n-1) is defined only up to the caller's
+        # mask (the fused kernel zeroes its out-of-window rows; the XLA
+        # chain yields a finite nonzero value) — compare real entries
+        real = np.asarray(i) != n - 1
+        got, want = got[real], want[real]
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6,
+                               err_msg=f"{op}/{layout}/fused={fused}")
+    assert np.all(np.isfinite(got))
+
+
+def test_single_edge_receiver_softmax_is_exactly_one(monkeypatch):
+    """A receiver with one in-edge must get attention weight exactly 1.0 on
+    BOTH routes (the fused kernel's exp(x-x)/exp(x-x) and the chain's)."""
+    d, i, n = _layout("single_edge_receivers", e=384)
+    for fused in ("0", "1"):
+        monkeypatch.setenv("HYDRAGNN_FUSED_SOFTMAX", fused)
+        out = np.asarray(segment.segment_softmax(d, i, n))
+        np.testing.assert_array_equal(out, np.ones_like(out),
+                                      err_msg=f"fused={fused}")
+
+
+def test_segment_sum_grad_parity_under_both_flags(monkeypatch):
+    """Backward pass of the routed segment_sum on the collate layout — the
+    fused scatter's VJP vs jax.ops, under each flag."""
+    d, i, n = _layout("collate")
+    grads = {}
+    for fused in ("0", "1"):
+        monkeypatch.setenv("HYDRAGNN_FUSED_SCATTER", fused)
+        grads[fused] = np.asarray(jax.grad(
+            lambda x: (segment.segment_sum(x, i, n) ** 2).sum()
+        )(d))
+    np.testing.assert_allclose(grads["0"], grads["1"], rtol=1e-5, atol=1e-6)
