@@ -825,6 +825,332 @@ def bench_serving_ab(batch_size: int = 32, n_requests: int = 160,
     }
 
 
+def _fleet_model_ingredients(batch_size: int, n_samples: int = 256,
+                             seed: int = 41):
+    """Tiny GIN serving ingredients shared by the fleet rows (same family
+    as ``bench_serving_ab``): (aug config, model, state, samples)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train import create_train_state, select_optimizer
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 64
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    samples = make_qm9_like_samples(max(batch_size * 4, n_samples), seed=seed)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    example = next(iter(GraphLoader(samples, batch_size)))
+    state = create_train_state(
+        model, optimizer, jax.tree.map(jnp.asarray, example)
+    )
+    return cfg, model, state, samples
+
+
+def bench_fleet_serving_ab(batch_size: int = 32, n_requests: int = 96,
+                           windows: int = 4, zipf_alpha: float = 1.1) -> dict:
+    """Fleet row 1 (ISSUE 11): the multi-process-shaped RPC front end vs a
+    direct in-process ``PredictionServer``, under Zipf-DUPLICATE traffic
+    (the heavy-head popularity shape the content-addressed answer cache
+    exists for). Two warm replicas behind one router; the direct arm
+    submits to replica A's server in-process. CPU-provable columns:
+
+    * **parity** — one probe served both paths is ``np.array_equal``
+      (fp32/CPU), and a duplicate request's CACHE-HIT arrays bit-match the
+      computed answer (acceptance: bit-identical including cache hits);
+    * **cache hit-rate** under the seeded Zipf-duplicate stream + the
+      graphs/sec both arms sustain;
+    * **0 steady lowerings per replica**, read over the wire (the AOT
+      zero-recompile guarantee crossing the RPC boundary);
+    * router-overhead ABBA (shared ``_abba_verdict``, informational
+      budget 50% — the router pays one loopback RPC per MISS and zero
+      replica compute per HIT, so under duplicate-heavy traffic the
+      overhead shrinks as the cache warms).
+    """
+    import numpy as _np
+
+    from hydragnn_tpu.serve import (
+        FleetRouter,
+        PredictionServer,
+        ReplicaHost,
+        ServingConfig,
+        run_traffic,
+        zipf_duplicate_order,
+    )
+
+    cfg, model, state, samples = _fleet_model_ingredients(batch_size)
+    servers = []
+    t0 = time.perf_counter()
+    for _ in range(2):
+        srv = PredictionServer(ServingConfig(
+            flush_ms=3.0, queue_depth=max(512, n_requests)
+        ))
+        srv.add_model("m", model, state, cfg, samples=samples,
+                      batch_size=batch_size)
+        srv.warmup(verify=True)
+        srv.start()
+        servers.append(srv)
+    warmup_s = time.perf_counter() - t0
+    # hosts AFTER every warm-up: each host snapshots the lowering counter
+    # at ready, and a sibling's warm-up lowering must not bill against it
+    hosts = [ReplicaHost(srv) for srv in servers]
+
+    def make_router(cache_bytes: int) -> "FleetRouter":
+        r = FleetRouter({
+            "peer_timeout": 30.0, "cache_bytes": cache_bytes,
+            "inflight_per_replica": 4,
+        })
+        for h in hosts:
+            r.attach("127.0.0.1", h.port)
+        return r.start()
+
+    router_nc = make_router(0)            # overhead arm: no cache
+    router = make_router(32 * 1024 * 1024)  # cache arm
+    orders = [
+        zipf_duplicate_order(n_requests, len(samples), alpha=zipf_alpha,
+                             seed=w)
+        for w in range(max(windows, 1))
+    ]
+    try:
+        # bit parity, direct vs routed vs CACHE HIT, on one probe graph
+        probe = samples[0]
+        direct_heads = [
+            _np.asarray(a)
+            for a in servers[0].submit("m", probe).result(timeout=60)["heads"]
+        ]
+        routed = router.submit("m", probe).result(timeout=60)
+        hit = router.submit("m", probe).result(timeout=60)
+        parity = all(
+            _np.array_equal(d, _np.asarray(r))
+            for d, r in zip(direct_heads, routed["heads"])
+        ) and bool(hit.get("cached")) and all(
+            _np.array_equal(d, _np.asarray(r))
+            for d, r in zip(direct_heads, hit["heads"])
+        )
+        # burn-in: settle allocators AND warm the cache arm on the exact
+        # window orders, so every timed arm below is stationary (an
+        # in-window warming cache would smear trend into the ABBA noise)
+        run_traffic(servers[0], "m", samples, n_requests, order=orders[0])
+        run_traffic(router_nc, "m", samples, n_requests, order=orders[0])
+        for order in orders:
+            run_traffic(router, "m", samples, n_requests, order=order)
+        # ABBA 1 — router overhead: direct in-process server vs the
+        # NO-CACHE router on identical Zipf windows (every request pays
+        # the loopback RPC; this is the front end's honest price)
+        a_ms, nc_ms, c_ms = [], [], []
+        for w, order in enumerate(orders):
+            arms = [
+                ("a", lambda o=order, w=w: run_traffic(
+                    servers[0], "m", samples, n_requests, order=o, seed=w)),
+                ("nc", lambda o=order, w=w: run_traffic(
+                    router_nc, "m", samples, n_requests, order=o, seed=w)),
+                ("c", lambda o=order, w=w: run_traffic(
+                    router, "m", samples, n_requests, order=o, seed=w)),
+            ]
+            if w % 2 == 1:
+                arms = arms[::-1]
+            for name, fn in arms:
+                wall = 1e3 * fn().wall_s
+                {"a": a_ms, "nc": nc_ms, "c": c_ms}[name].append(wall)
+        cache = router.cache.stats()
+        hit_rate = cache["hit_rate"] or 0.0
+        lowerings = [
+            router.replica_stats(r)["steady_lowerings"]
+            for r in range(len(hosts))
+        ]
+        fleet_stats = router.stats()
+    finally:
+        router.stop()
+        router_nc.stop()
+        for h in hosts:
+            h.close()
+        for srv in servers:
+            srv.stop()
+    overhead_pct, overhead_noise, _ = _abba_verdict(a_ms, nc_ms,
+                                                    budget_pct=0.0)
+    cache_gain_pct, cache_noise, cache_verdict = _abba_verdict(
+        nc_ms, c_ms, budget_pct=0.0
+    )
+    return {
+        "workload": "fleet_serving_ab",
+        "n_replicas": len(hosts),
+        "n_requests_per_window": n_requests,
+        "zipf_alpha": zipf_alpha,
+        "warmup_s": round(warmup_s, 3),
+        "parity_bit_identical_incl_cache_hit": parity,
+        "cache_hit_rate": hit_rate,
+        "cache": cache,
+        "steady_lowerings_per_replica": lowerings,
+        "graphs_per_sec_direct": round(
+            n_requests / (statistics.median(a_ms) / 1e3), 1
+        ),
+        "graphs_per_sec_fleet_nocache": round(
+            n_requests / (statistics.median(nc_ms) / 1e3), 1
+        ),
+        "graphs_per_sec_fleet_cached": round(
+            n_requests / (statistics.median(c_ms) / 1e3), 1
+        ),
+        "window_ms_direct": [round(x, 2) for x in a_ms],
+        "window_ms_fleet_nocache": [round(x, 2) for x in nc_ms],
+        "window_ms_fleet_cached": [round(x, 2) for x in c_ms],
+        # the front end's price vs in-process submission (no verdict: the
+        # RPC hop costs what it costs on this box; the row's claims are
+        # the cache, the parity, and the zero-lowering replicas)
+        "router_overhead_pct": round(overhead_pct, 2),
+        "router_overhead_noise_pct": round(overhead_noise, 2),
+        # the cache's effect at the SAME router (warm, stationary):
+        # negative = cached arm faster; verdict at budget 0
+        "cache_gain_pct": round(cache_gain_pct, 2),
+        "cache_noise_pct": round(cache_noise, 2),
+        "cache_abba_verdict": cache_verdict,
+        "served_by_replica": [
+            r["served"] for r in fleet_stats["replicas"]
+        ],
+        # the row's acceptance verdict: bit parity (incl. the cache hit),
+        # a working cache under duplicate traffic, and zero steady
+        # lowerings on every replica
+        "verdict": (
+            "pass"
+            if parity and hit_rate > 0.1 and all(x == 0 for x in lowerings)
+            else "fail"
+        ),
+        "batch_size": batch_size,
+    }
+
+
+def bench_fleet_overload_ab(n_flood: int = 48, n_probes: int = 24,
+                            windows: int = 4, stall_s: float = 0.02) -> dict:
+    """Fleet row 2 (ISSUE 11): interactive p99 UNDER OVERLOAD, priority
+    classes + deadline shedding ON vs OFF, through one stalled replica
+    (``set_delay`` makes every answer cost ``stall_s`` — deterministic
+    overload, no timing luck needed to saturate).
+
+    * arm A (off): flood + probes all submitted as ONE class (FIFO — the
+      no-priority router every naive deployment starts as), no deadlines;
+    * arm B (on): flood as ``best_effort`` WITH deadlines, probes as
+      ``interactive`` — strict-priority dispatch jumps probes ahead and
+      the expired flood tail sheds typed instead of burning replica time.
+
+    Columns: per-window probe p99 both arms, flood shed counts, and the
+    shared ``_abba_verdict`` at budget 0 on the p99 pairs ('pass' = the
+    priority arm's interactive p99 clears the noise floor)."""
+    import numpy as _np
+
+    from hydragnn_tpu.serve import (
+        DeadlineExceededError,
+        FleetRouter,
+        PredictionServer,
+        ReplicaHost,
+        ServingConfig,
+    )
+
+    cfg, model, state, samples = _fleet_model_ingredients(32, n_samples=128)
+    server = PredictionServer(ServingConfig(
+        flush_ms=1.0, queue_depth=max(512, n_flood + n_probes)
+    ))
+    server.add_model("m", model, state, cfg, samples=samples, batch_size=32)
+    server.warmup(verify=True)
+    server.start()
+    host = ReplicaHost(server)
+
+    def window(priorities_on: bool) -> dict:
+        router = FleetRouter({
+            "peer_timeout": 30.0, "cache_bytes": 0,
+            "inflight_per_replica": 1,
+            "budget_interactive": max(64, n_probes),
+            "budget_batch": max(128, n_flood + n_probes),
+            "budget_best_effort": max(64, n_flood),
+        })
+        router.attach("127.0.0.1", host.port)
+        router.start()
+        host.set_delay(stall_s)
+        try:
+            flood_kw = (
+                {"priority": "best_effort", "deadline_ms": 1e3 * stall_s * 12}
+                if priorities_on else {"priority": "batch"}
+            )
+            probe_kw = (
+                {"priority": "interactive"} if priorities_on
+                else {"priority": "batch"}
+            )
+            flood = [
+                router.submit("m", samples[i % 16], **flood_kw)
+                for i in range(n_flood)
+            ]
+            probes = []
+            for i in range(n_probes):
+                t0 = time.perf_counter()
+                probes.append((t0, router.submit(
+                    "m", samples[i % 8], **probe_kw
+                )))
+            lat = []
+            for t0, f in probes:
+                f.result(timeout=120)
+                lat.append(time.perf_counter() - t0)
+            shed = 0
+            for f in flood:
+                try:
+                    f.result(timeout=120)
+                except DeadlineExceededError:
+                    shed += 1
+            return {
+                "p99_ms": round(1e3 * float(_np.percentile(lat, 99)), 3),
+                "p50_ms": round(1e3 * float(_np.percentile(lat, 50)), 3),
+                "flood_shed": shed,
+            }
+        finally:
+            host.set_delay(0.0)
+            router.stop()
+
+    try:
+        window(False)  # untimed burn-in
+        a, b = [], []
+        for w in range(max(windows, 1)):
+            if w % 2 == 0:
+                a.append(window(False))
+                b.append(window(True))
+            else:
+                b.append(window(True))
+                a.append(window(False))
+    finally:
+        host.close()
+        server.stop()
+    a_p99 = [x["p99_ms"] for x in a]
+    b_p99 = [x["p99_ms"] for x in b]
+    overhead_pct, noise_pct, verdict = _abba_verdict(a_p99, b_p99,
+                                                     budget_pct=0.0)
+    return {
+        "workload": "fleet_overload_ab",
+        "n_flood": n_flood,
+        "n_probes": n_probes,
+        "replica_stall_ms": round(1e3 * stall_s, 1),
+        "p99_ms_interactive_shedding_off": round(statistics.median(a_p99), 3),
+        "p99_ms_interactive_shedding_on": round(statistics.median(b_p99), 3),
+        "p50_ms_shedding_off": round(
+            statistics.median([x["p50_ms"] for x in a]), 3
+        ),
+        "p50_ms_shedding_on": round(
+            statistics.median([x["p50_ms"] for x in b]), 3
+        ),
+        "window_p99_ms_off": a_p99,
+        "window_p99_ms_on": b_p99,
+        "flood_shed_per_window_on": [x["flood_shed"] for x in b],
+        "flood_shed_per_window_off": [x["flood_shed"] for x in a],
+        "p99_improvement_x": round(
+            statistics.median(a_p99) / max(statistics.median(b_p99), 1e-9), 2
+        ),
+        # _abba_verdict measures B-vs-A overhead; negative = priorities win
+        "priority_overhead_pct": round(overhead_pct, 2),
+        "noise_pct": round(noise_pct, 2),
+        "verdict": verdict,
+    }
+
+
 def _iqr(xs):
     s = sorted(xs)
     if len(s) < 4:  # too few windows for quartiles: full range (>= 0)
@@ -1557,6 +1883,12 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
     fused_softmax = _row(bench_fused_softmax_ab, min(batch_size, 64), 8)
     cell_list = _row(bench_cell_list_ab, 2048, 4)
     quant = _row(bench_quant_serving_ab, 32)
+    # ISSUE 11 fleet rows: loopback RPC + cache + priorities are
+    # CPU-provable by construction, so the smoke fallback carries them too
+    fleet = _row(bench_fleet_serving_ab, min(batch_size, 32), 64, 2)
+    # 4 windows even in the smoke: _abba_verdict refuses a hard verdict
+    # under 4 pairs, and the overload row's p99 claim deserves one
+    fleet_overload = _row(bench_fleet_overload_ab, 32, 16, 4)
     return {
         "workload": "cpu_smoke",
         "degraded": True,
@@ -1571,6 +1903,8 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
         "fused_softmax_ab": fused_softmax,
         "cell_list_ab": cell_list,
         "quant_serving_ab": quant,
+        "fleet_serving_ab": fleet,
+        "fleet_overload_ab": fleet_overload,
     }
 
 
@@ -2123,6 +2457,12 @@ def child_main(status_path: str) -> None:
     plan.append(("fused_softmax_ab", lambda: bench_fused_softmax_ab()))
     plan.append(("cell_list_ab", lambda: bench_cell_list_ab()))
     plan.append(("quant_serving_ab", lambda: bench_quant_serving_ab()))
+    # ISSUE 11 acceptance rows: fleet router vs direct server under
+    # Zipf-duplicate traffic (cache hit-rate, parity incl. cache hits, 0
+    # steady lowerings per replica) + interactive p99 under overload with
+    # priority classes/shedding on vs off — both CPU-provable
+    plan.append(("fleet_serving_ab", lambda: bench_fleet_serving_ab()))
+    plan.append(("fleet_overload_ab", lambda: bench_fleet_overload_ab()))
     if os.getenv("BENCH_FUSED_AUTOTUNE", "1") != "0":
         # cheap kernel-only sweep BEFORE the compile-heavy arch entries, so
         # a short window still yields the tuning data it was added for

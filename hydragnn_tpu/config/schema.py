@@ -158,9 +158,26 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
             f"Unknown Serving key(s) {sorted(unknown)}; known: "
             f"{sorted(serving_defaults)}"
         )
+    # nested Serving.fleet block (serve/fleet): fill its keys from the
+    # FleetConfig dataclass defaults BEFORE the flat setdefault loop, so a
+    # partial fleet block keeps the caller's keys and gains the rest
+    fleet_cfg = serving_cfg.setdefault("fleet", {})
+    if not isinstance(fleet_cfg, dict):
+        raise ValueError(
+            f"Serving.fleet must be a dict, got {type(fleet_cfg).__name__}"
+        )
+    from ..serve.fleet.config import fleet_config_defaults
+
+    # unknown-key rejection lives in ServingConfig.validate() below (the
+    # one implementation); unknown keys survive this back-fill untouched
+    # and raise there
+    for key, val in fleet_config_defaults().items():
+        fleet_cfg.setdefault(key, val)
     for key, val in serving_defaults.items():
         serving_cfg.setdefault(key, val)
-    ServingConfig(**serving_cfg).validate()  # one range-check implementation
+    # one range-check implementation; also validates the fleet block
+    # through FleetConfig
+    ServingConfig(**serving_cfg).validate()
 
     # on-device MD (hydragnn_tpu.md): the top-level MD block's defaults ARE
     # the MDConfig dataclass field defaults (same single-source pattern);

@@ -42,16 +42,19 @@ MISCONFIGURATION (two jobs sharing a fabric, a peer dialing the wrong
 port), not against a network attacker: the token travels plaintext over
 unencrypted TCP and is replayable. Genuinely untrusted networks need
 transport security (TLS/WireGuard) underneath, same as MPI would.
+
+The transport itself (framing, codec, auth check, pooled sockets,
+watchdog-bracketed round-trips, quarantine clock) lives in
+``hydragnn_tpu.utils.wire`` — ONE implementation shared with the fleet
+serving tier (``serve/fleet``), factored out of this module where PR 4
+grew it. This module keeps the data-plane policy: shard ownership,
+replica failover, the re-probe prober, the sample cache.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import hmac
 import socket
-import socketserver
-import struct
-import sys
 import threading
 import time
 import warnings
@@ -62,9 +65,28 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from ..graphs.graph import GraphSample
+from ..utils import wire
+from ..utils.wire import (
+    ConnPool as _ConnPool,  # noqa: F401  (back-compat alias)
+    HealthTable,
+    RoundTripper,
+    WireServer,
+    check_pong,
+)
 from .packed import PackedDataset
 
-_HDR = struct.Struct("<q")  # payload byte length
+# back-compat aliases: the wire protocol grew here (PR 4) and tests/tools
+# import these by their original private names
+_pack_arrays = wire.pack_arrays
+_unpack_arrays = wire.unpack_arrays
+_send_msg = wire.send_msg
+_recv_msg = wire.recv_msg
+_recv_exact = wire.recv_exact
+_sample_to_arrays = wire.sample_to_arrays
+_sample_from_arrays = wire.sample_from_arrays
+_copy_sample = wire.copy_sample
+_encode_samples = wire.encode_samples
+_samples_from_frame = wire.samples_from_frame
 
 
 @dataclasses.dataclass(frozen=True)
@@ -120,175 +142,11 @@ def live_servers() -> "list[ShardServer]":
     return [srv for _, srv in items if not srv.closed]
 
 
-def _send_msg(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_HDR.pack(len(payload)) + payload)
-
-
-_MAGIC = b"GSX1"
-
-
-def _pack_arrays(d: dict[str, np.ndarray]) -> bytes:
-    """dict[str, ndarray] -> compact binary frame. ~50x faster than ``.npz``
-    (zipfile is pure Python and dominated the TCP tier's CPU budget); the
-    dtype travels as its ``.str`` spec, never as a pickled object."""
-    parts = [_MAGIC, struct.pack("<I", len(d))]
-    for k, v in d.items():
-        v = np.ascontiguousarray(v)
-        if v.dtype.hasobject:
-            raise ValueError("object arrays are not allowed on the wire")
-        name = k.encode()
-        dt = v.dtype.str.encode()
-        parts.append(struct.pack("<H", len(name)))
-        parts.append(name)
-        parts.append(struct.pack("<B", len(dt)))
-        parts.append(dt)
-        parts.append(struct.pack("<B", v.ndim))
-        if v.ndim:
-            parts.append(struct.pack(f"<{v.ndim}q", *v.shape))
-        raw = v.tobytes()
-        parts.append(struct.pack("<q", len(raw)))
-        parts.append(raw)
-    return b"".join(parts)
-
-
-def _unpack_arrays(buf: bytes) -> dict[str, np.ndarray]:
-    """Inverse of ``_pack_arrays``; arrays are zero-copy views into ``buf``.
-    Every length is validated against the payload before slicing, and ANY
-    malformed frame — bad magic, truncated header, unknown dtype — raises
-    ``ValueError`` (never struct.error/TypeError leaking to callers)."""
-    try:
-        if buf[:4] != _MAGIC:
-            raise ValueError(
-                "bad wire magic (peer speaks a different protocol?)"
-            )
-        mv = memoryview(buf)
-        off = 4
-        (n,) = struct.unpack_from("<I", buf, off)
-        off += 4
-        out: dict[str, np.ndarray] = {}
-        for _ in range(n):
-            (nl,) = struct.unpack_from("<H", buf, off)
-            off += 2
-            if off + nl > len(buf):
-                raise ValueError("truncated frame (name)")
-            name = bytes(mv[off:off + nl]).decode()
-            off += nl
-            (dl,) = struct.unpack_from("<B", buf, off)
-            off += 1
-            if off + dl > len(buf):
-                raise ValueError("truncated frame (dtype)")
-            dt = np.dtype(bytes(mv[off:off + dl]).decode())
-            off += dl
-            if dt.hasobject:
-                raise ValueError("object arrays are not allowed on the wire")
-            (nd,) = struct.unpack_from("<B", buf, off)
-            off += 1
-            shape = struct.unpack_from(f"<{nd}q", buf, off) if nd else ()
-            off += 8 * nd
-            (nb,) = struct.unpack_from("<q", buf, off)
-            off += 8
-            count = int(np.prod(shape, dtype=np.int64)) if nd else 1
-            if count < 0 or nb != count * dt.itemsize or off + nb > len(buf):
-                raise ValueError(f"corrupt frame for array {name!r}")
-            out[name] = np.frombuffer(mv[off:off + nb], dtype=dt).reshape(shape)
-            off += nb
-        return out
-    except ValueError:
-        raise
-    except (struct.error, TypeError, UnicodeDecodeError) as e:
-        raise ValueError(f"corrupt frame: {e}") from None
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed mid-message")
-        buf.extend(chunk)
-    return bytes(buf)
-
-
-def _recv_msg(sock: socket.socket) -> bytes:
-    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    if n < 0 or n > (1 << 33):
-        raise ValueError(f"bad message length {n}")
-    return _recv_exact(sock, n)
-
-
-# GraphSample <-> flat dict of arrays (npz-safe: no object dtypes)
-_ARRAY_FIELDS = (
-    "x", "pos", "senders", "receivers", "edge_attr", "edge_shifts",
-    "graph_y", "node_y", "energy_y", "forces_y", "graph_attr",
-)
-_EXTRA_FIELDS = ("node_table", "graph_table")
-
-
-def _sample_to_arrays(s: GraphSample) -> dict[str, np.ndarray]:
-    out = {}
-    for f in _ARRAY_FIELDS:
-        v = getattr(s, f)
-        if v is not None:
-            out[f] = np.asarray(v)
-    for f in _EXTRA_FIELDS:
-        if f in s.extras:
-            out["extra_" + f] = np.asarray(s.extras[f])
-    out["dataset_id"] = np.asarray(s.dataset_id, np.int32)
-    return out
-
-
-def _sample_from_arrays(d: dict[str, np.ndarray]) -> GraphSample:
-    # np.array: decoded frames are read-only frombuffer views; samples must
-    # be writable (downstream transforms mutate in place)
-    kw = {f: np.array(d[f]) for f in _ARRAY_FIELDS if f in d}
-    s = GraphSample(dataset_id=int(d["dataset_id"]), **kw)
-    for f in _EXTRA_FIELDS:
-        if "extra_" + f in d:
-            s.extras[f] = np.array(d["extra_" + f])
-    return s
-
-
-def _copy_sample(s: GraphSample) -> GraphSample:
-    """Independent deep-ish copy: fresh array buffers, fresh extras dict.
-    The LRU cache hands these out because downstream transforms mutate
-    samples in place — a cache that returns its own instances corrupts
-    every later hit of the same index (ADVICE.md r5)."""
-    out = GraphSample.__new__(GraphSample)
-    for f in GraphSample.__slots__:
-        v = getattr(s, f)
-        if isinstance(v, np.ndarray):
-            v = v.copy()
-        elif f == "extras":
-            v = {
-                k: (x.copy() if isinstance(x, np.ndarray) else x)
-                for k, x in v.items()
-            }
-        setattr(out, f, v)
-    return out
-
-
-def _encode_samples(samples: list[GraphSample]) -> bytes:
-    flat = {}
-    for i, s in enumerate(samples):
-        for k, v in _sample_to_arrays(s).items():
-            flat[f"s{i}_{k}"] = v
-    flat["n"] = np.asarray(len(samples), np.int64)
-    return _pack_arrays(flat)
-
-
-def _samples_from_frame(z: dict[str, np.ndarray]) -> list[GraphSample]:
-    n = int(z["n"])
-    out = []
-    for i in range(n):
-        prefix = f"s{i}_"
-        d = {k[len(prefix):]: v for k, v in z.items() if k.startswith(prefix)}
-        out.append(_sample_from_arrays(d))
-    return out
-
-
-class ShardServer:
+class ShardServer(WireServer):
     """Threaded TCP server answering batched sample fetches from the local
-    shard. Request: a ``_pack_arrays`` frame {"idx": int64[k] LOCAL indices,
+    shard (on the shared ``utils.wire`` transport — auth, ping, instant
+    dead-host ``close()``, and chaos ``set_delay`` live in ``WireServer``).
+    Request: a ``pack_arrays`` frame {"idx": int64[k] LOCAL indices,
     "range": [start, stop] the GLOBAL range the client believes this server
     owns}; response:
     the encoded samples, or an error record when the range doesn't match —
@@ -311,248 +169,40 @@ class ShardServer:
     def __init__(self, ds: PackedDataset, start: int, stop: int,
                  host: str = "0.0.0.0", auth_token: str | None = None,
                  port: int = 0, _test_delay_s: float = 0.0):
-        outer = self
-        tok = None if auth_token is None else auth_token.encode()
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self) -> None:
-                with outer._conns_lock:
-                    # registration and the close() snapshot share one lock:
-                    # a connection either lands in the snapshot (severed by
-                    # close) or observes closed here — no window where a
-                    # just-accepted socket outlives the "dead" host
-                    if outer.closed:
-                        return
-                    outer._conns.add(self.request)
-                try:
-                    self._serve_requests()
-                finally:
-                    with outer._conns_lock:
-                        outer._conns.discard(self.request)
-
-            def _serve_requests(self) -> None:
-                try:
-                    while True:
-                        try:
-                            z = _unpack_arrays(_recv_msg(self.request))
-                        except ValueError:
-                            # malformed frame: drop the connection — one
-                            # line of diagnostics, no per-request traceback
-                            # spam from a misbehaving peer
-                            print(
-                                f"[ShardServer:{outer.port}] dropping peer "
-                                f"{self.client_address}: malformed frame",
-                                file=sys.stderr,
-                            )
-                            return
-                        if outer._test_delay_s:
-                            time.sleep(outer._test_delay_s)
-                        got_tok = z.get("token")
-                        if tok is not None and (
-                            got_tok is None
-                            or not hmac.compare_digest(
-                                np.asarray(got_tok).tobytes(), tok
-                            )
-                        ):
-                            _send_msg(self.request, _pack_arrays(
-                                {"n": np.asarray(-2, np.int64)}
-                            ))
-                            continue
-                        if "ping" in z:
-                            # health probe (piggybacked on the fetch
-                            # protocol): answer with the served range so a
-                            # prober can verify it is talking to the peer
-                            # it thinks it is before lifting a quarantine
-                            _send_msg(self.request, _pack_arrays({
-                                "n": np.asarray(0, np.int64),
-                                "pong": np.asarray(1, np.int64),
-                                "have": np.asarray(
-                                    [outer.start, outer.stop], np.int64
-                                ),
-                            }))
-                            continue
-                        want = z.get("range")
-                        if want is not None and (
-                            int(want[0]) != outer.start or int(want[1]) != outer.stop
-                        ):
-                            _send_msg(self.request, _pack_arrays({
-                                "n": np.asarray(-1, np.int64),
-                                "have": np.asarray(
-                                    [outer.start, outer.stop], np.int64
-                                ),
-                            }))
-                            continue
-                        try:
-                            if "sizes" in z:
-                                # size-table op: (num_nodes, num_edges) for
-                                # the whole shard straight from the count
-                                # index — bucket planning never pulls
-                                # sample content
-                                resp = _pack_arrays({
-                                    "n": np.asarray(0, np.int64),
-                                    "sizes": outer.ds.sample_sizes(
-                                        range(outer.stop - outer.start)
-                                    ),
-                                })
-                            else:
-                                resp = _encode_samples(
-                                    [outer.ds[int(i)] for i in z["idx"]]
-                                )
-                        except Exception as e:
-                            # server-side failure: tell the CLIENT what
-                            # broke instead of closing with no diagnostics
-                            resp = _pack_arrays({
-                                "n": np.asarray(-3, np.int64),
-                                "detail": np.frombuffer(
-                                    f"{type(e).__name__}: {e}".encode()[:512],
-                                    np.uint8,
-                                ),
-                            })
-                        _send_msg(self.request, resp)
-                except (ConnectionError, OSError):
-                    return
-
-        class Server(socketserver.ThreadingTCPServer):
-            daemon_threads = True
-            allow_reuse_address = True
-
         self.ds = ds
         self.start, self.stop = int(start), int(stop)
-        self._test_delay_s = float(_test_delay_s)
-        self._conns: set[socket.socket] = set()  # live handler sockets
-        self._conns_lock = threading.Lock()
         # port=0 picks an ephemeral port (the default); a fixed port lets a
         # restarted host come back at the address its peers already
         # advertise, so the prober's quarantine-lift finds it
-        self._srv = Server((host, int(port)), Handler)
-        self.port = self._srv.server_address[1]
-        self.closed = False
-
-        def _serve() -> None:
-            try:
-                self._srv.serve_forever()
-            except Exception:
-                # close() severs the listening socket out from under the
-                # select loop for an IMMEDIATE stop; the resulting EBADF
-                # is the expected way down, anything else is real
-                if not self.closed:
-                    raise
-
-        self._thread = threading.Thread(target=_serve, daemon=True)
-        self._thread.start()
+        super().__init__(host=host, port=port, auth_token=auth_token,
+                         name="ShardServer", _test_delay_s=_test_delay_s)
         with _LIVE_SERVERS_LOCK:
             _LIVE_SERVERS_SEQ[0] += 1
             _LIVE_SERVERS[_LIVE_SERVERS_SEQ[0]] = self
 
-    def set_delay(self, seconds: float) -> None:
-        """Delay every response by ``seconds`` — the chaos harness's
-        ``slow_peer`` hook (same mechanism as the ``_test_delay_s`` test
-        knob): a response slower than the client's peer_timeout makes this
-        server a gray failure that fetches must fail over around."""
-        self._test_delay_s = float(seconds)
+    def pong_fields(self) -> dict:
+        # the prober verifies it is talking to the peer it thinks it is
+        # (the advertised range) before lifting a quarantine
+        return {"have": np.asarray([self.start, self.stop], np.int64)}
 
-    def close(self) -> None:
-        """Stop serving LIKE A DEAD HOST: immediately (no shutdown-poll
-        wait — a chaos kill inside a timed epoch must not bill the victim's
-        teardown to the client) and completely — the listening socket AND
-        every established connection are severed, so pooled client sockets
-        error on reuse instead of being silently served by a 'dead' peer."""
-        with self._conns_lock:
-            if self.closed:
-                return
-            self.closed = True
-            conns = list(self._conns)
-        self._srv.server_close()  # refuses new connects from this instant
-        for c in conns:
-            try:
-                c.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                c.close()
-            except OSError:
-                pass
-        # reap the serve loop off-thread: BaseServer.shutdown() blocks up
-        # to its 0.5s poll interval, which callers should never pay
-        threading.Thread(target=self._srv.shutdown, daemon=True).start()
-
-
-class _ConnPool:
-    """Per-peer socket pool. Each concurrent ``fetch`` checks out its own
-    socket (creating one when none is idle), runs its request/response
-    round-trip WITHOUT any shared lock, and returns the socket afterwards —
-    so N prefetch workers overlap N remote fetches, the concurrency the
-    reference gets from per-rank MPI RMA windows
-    (``distdataset.py:72-367``). Idle sockets per peer are capped; excess
-    ones close on release."""
-
-    def __init__(self, max_idle_per_peer: int = 4, timeout: float = 120.0):
-        self._idle: dict[int, list[socket.socket]] = {}
-        self._lock = threading.Lock()
-        self._max_idle = int(max_idle_per_peer)
-        self._closed = False
-        self.timeout = float(timeout)  # connect AND per-recv deadline
-
-    def acquire(self, rank: int, host: str, port: int) -> tuple[socket.socket, bool]:
-        """Returns (socket, from_pool). A pooled socket may have gone stale
-        while idle — callers retry once on a fresh one; a FRESH connection
-        failing is a real error. ``self.timeout`` bounds both the connect
-        AND every later recv on the socket (``create_connection`` leaves
-        its timeout armed), so a hung peer surfaces as ``socket.timeout`` —
-        an ``OSError`` the failover path treats as peer-down — instead of
-        parking the fetch forever."""
-        # <=0 means NO deadline (blocking), matching _guard_round_trip's
-        # "disabled for zero timeouts" convention — socket timeout 0.0 is
-        # Python's NON-BLOCKING mode, which would instantly fail every
-        # connect with BlockingIOError and quarantine healthy peers
-        timeout = self.timeout if self.timeout and self.timeout > 0 else None
-        with self._lock:
-            stack = self._idle.get(rank)
-            while stack:
-                sock = stack.pop()
-                try:
-                    sock.settimeout(timeout)  # policy may have changed
-                except OSError:
-                    continue  # closed while parked: discard, try the next
-                return sock, True
-        return socket.create_connection((host, port), timeout=timeout), False
-
-    def release(self, rank: int, sock: socket.socket) -> None:
-        with self._lock:
-            # a release racing close() (in-flight fetch during teardown)
-            # must not re-park into the cleared pool — close the socket
-            if not self._closed:
-                stack = self._idle.setdefault(rank, [])
-                if len(stack) < self._max_idle:
-                    stack.append(sock)
-                    return
-        try:
-            sock.close()
-        except OSError:
-            pass
-
-    def evict(self, rank: int) -> None:
-        """Close and drop every idle socket pooled for ``rank`` — called
-        when a peer is quarantined, so a later un-quarantine never checks
-        out a socket that spent the whole outage parked half-dead."""
-        with self._lock:
-            stack = self._idle.pop(rank, [])
-        for sock in stack:
-            try:
-                sock.close()
-            except OSError:
-                pass
-
-    def close(self) -> None:
-        with self._lock:
-            self._closed = True
-            for stack in self._idle.values():
-                for sock in stack:
-                    try:
-                        sock.close()
-                    except OSError:
-                        pass
-            self._idle.clear()
+    def handle_frame(self, z: dict) -> bytes | dict:
+        want = z.get("range")
+        if want is not None and (
+            int(want[0]) != self.start or int(want[1]) != self.stop
+        ):
+            return {
+                "n": np.asarray(-1, np.int64),
+                "have": np.asarray([self.start, self.stop], np.int64),
+            }
+        if "sizes" in z:
+            # size-table op: (num_nodes, num_edges) for the whole shard
+            # straight from the count index — bucket planning never pulls
+            # sample content
+            return {
+                "n": np.asarray(0, np.int64),
+                "sizes": self.ds.sample_sizes(range(self.stop - self.start)),
+            }
+        return _encode_samples([self.ds[int(i)] for i in z["idx"]])
 
 
 class ShardedStore:
@@ -664,7 +314,12 @@ class ShardedStore:
         # clients prefer DIFFERENT replicas so replicated reads spread
         # instead of hammering each range's first-listed owner
         self._rot = (self.start * 2654435761 + self.stop) % (1 << 31)
-        self._pool = _ConnPool(max_idle_conns_per_peer, timeout=self.peer_timeout)
+        # the shared wire client: pooled sockets + token stamping +
+        # watchdog-bracketed round-trips (utils.wire.RoundTripper)
+        self._rt = RoundTripper(
+            self.peer_timeout, auth_token=auth_token,
+            max_idle_per_peer=max_idle_conns_per_peer,
+        )
         # the lock guards ONLY cache/telemetry bookkeeping; network
         # round-trips run outside it so concurrent fetches overlap
         self._lock = threading.Lock()
@@ -676,14 +331,28 @@ class ShardedStore:
         self.remote_fetches = 0  # telemetry: audited by tests/bench
         self.failover_fetches = 0  # samples re-fetched from a replica
         self.quarantine_events = 0  # peer-down transitions observed
-        # health table: rank -> {"until", "backoff", "failures"}; a rank is
-        # quarantined while now < until AND the entry exists (the prober —
-        # or a successful last-resort fetch — removes it)
-        self._health: dict[int, dict] = {}
-        self._health_lock = threading.Lock()
+        # quarantine clock: rank -> {"until", "backoff", "failures"}; a rank
+        # is quarantined while now < until AND the entry exists (the prober —
+        # or a successful last-resort fetch — removes it). Shared
+        # implementation with the fleet router (utils.wire.HealthTable).
+        self._health_table = HealthTable(
+            self.quarantine_base_s, self.quarantine_cap_s
+        )
         self._probe_stop = threading.Event()
         self._probe_thread: threading.Thread | None = None
-        self._watchdog = None  # lazy: built on first remote round-trip
+
+    @property
+    def _pool(self):
+        """The per-peer socket pool (tests poke ``_idle``/``timeout``)."""
+        return self._rt.pool
+
+    @property
+    def _health(self) -> dict:
+        return self._health_table.entries
+
+    @property
+    def _health_lock(self):
+        return self._health_table.lock
 
     def _apply_env_overrides(self) -> None:
         from ..utils import flags
@@ -710,8 +379,11 @@ class ShardedStore:
             if cfg.get(key) is not None:
                 setattr(self, key, type(getattr(self, key))(cfg[key]))
         self._apply_env_overrides()
-        self._pool.timeout = self.peer_timeout
-        self._watchdog = None  # rebuilt with the new deadline on next fetch
+        # the timeout setter also drops the armed watchdog so the next
+        # round-trip rebuilds it with the new deadline
+        self._rt.timeout = self.peer_timeout
+        self._health_table.base_s = self.quarantine_base_s
+        self._health_table.cap_s = self.quarantine_cap_s
         self._check_replication()
 
     def _check_replication(self) -> None:
@@ -778,29 +450,16 @@ class ShardedStore:
 
     # -- peer health / quarantine -------------------------------------------
     def _quarantined(self, rank: int) -> bool:
-        with self._health_lock:
-            h = self._health.get(rank)
-            return h is not None and time.monotonic() < h["until"]
+        return self._health_table.quarantined(rank)
 
     def _bump_quarantine(self, rank: int) -> bool:
         """Record one more failure for ``rank`` in the health table —
         re-probe deadline pushed out by the current backoff, backoff
-        doubled up to the cap. THE single implementation of the quarantine
-        clock, shared by the fetch path and the prober (two copies would
-        silently diverge the first time the policy is tuned). Returns True
-        when this created the entry (a fresh peer-down transition)."""
-        with self._health_lock:
-            h = self._health.get(rank)
-            fresh = h is None
-            if fresh:
-                h = self._health[rank] = {
-                    "until": 0.0, "backoff": self.quarantine_base_s,
-                    "failures": 0,
-                }
-            h["failures"] += 1
-            h["until"] = time.monotonic() + h["backoff"]
-            h["backoff"] = min(h["backoff"] * 2.0, self.quarantine_cap_s)
-        return fresh
+        doubled up to the cap (``utils.wire.HealthTable`` — THE single
+        implementation of the quarantine clock, shared by the fetch path,
+        the prober, and the fleet router). Returns True when this created
+        the entry (a fresh peer-down transition)."""
+        return self._health_table.bump(rank)
 
     def _mark_peer_down(self, rank: int, err: BaseException, failover: bool) -> None:
         """Quarantine a peer after a transport failure: evict its pooled
@@ -822,8 +481,7 @@ class ShardedStore:
         self._ensure_prober()
 
     def _mark_peer_up(self, rank: int, announce: bool = False) -> None:
-        with self._health_lock:
-            was = self._health.pop(rank, None)
+        was = self._health_table.lift(rank)
         if was is not None and announce:
             host, port, s0, s1 = self.peers[rank]
             warnings.warn(
@@ -837,18 +495,8 @@ class ShardedStore:
         by a per-client constant so different clients spread load across
         replicas instead of all hammering the first-listed owner;
         quarantined peers last (soonest-re-probe first) as a final resort
-        when nothing healthy is left."""
-        healthy = [r for r in ranks if not self._quarantined(r)]
-        with self._health_lock:
-            sick = sorted(
-                (r for r in ranks if r not in healthy and r in self._health),
-                key=lambda r: self._health[r]["until"],
-            )
-        sick += [r for r in ranks if r not in healthy and r not in sick]
-        if healthy:
-            rot = self._rot % len(healthy)
-            healthy = healthy[rot:] + healthy[:rot]
-        return healthy + sick
+        when nothing healthy is left (``utils.wire.HealthTable.order``)."""
+        return self._health_table.order(ranks, rot=self._rot)
 
     def _ensure_prober(self) -> None:
         with self._health_lock:
@@ -894,16 +542,12 @@ class ShardedStore:
                             rank, host, port, attempts=1, _sock_cell=cell,
                             ping=np.asarray(1, np.int64),
                         ))
-                    have = z.get("have")
-                    if (
-                        have is None
-                        or int(have[0]) != s0
-                        or int(have[1]) != s1
-                    ):
-                        raise ConnectionError(
-                            f"probe pong advertises range {have}, expected "
-                            f"[{s0}, {s1})"
-                        )
+                    # the shared pong validation (wire.check_pong): the
+                    # peer must advertise the exact range it is listed for
+                    check_pong(
+                        z, f"probe of shard peer {host}:{port}",
+                        have=[s0, s1],
+                    )
                 except (ConnectionError, OSError):
                     self._bump_quarantine(rank)
                     continue
@@ -930,55 +574,18 @@ class ShardedStore:
         path does its own retrying ACROSS replicas, where a per-replica
         backoff loop would multiply the outage by the replica count.
         ``_sock_cell`` (when given) exposes the in-flight socket so a
-        watchdog can sever a wedged round-trip from its monitor thread."""
-        from ..utils.retry import RetryPolicy, call_with_retries, store_policy
+        watchdog can sever a wedged round-trip from its monitor thread.
+        The round-trip itself is ``utils.wire.RoundTripper.request`` —
+        this wrapper only resolves the retry policy (store flag vs pinned
+        attempts)."""
+        from ..utils.retry import RetryPolicy, store_policy
 
-        if self._auth_token is not None:
-            fields["token"] = np.frombuffer(self._auth_token.encode(), np.uint8)
-        req = _pack_arrays(fields)
         policy = (
             store_policy() if attempts is None
             else RetryPolicy(attempts=max(1, int(attempts)))
         )
-
-        def attempt_once() -> bytes:
-            while True:
-                sock, from_pool = self._pool.acquire(rank, host, port)
-                if _sock_cell is not None:
-                    _sock_cell["sock"] = sock
-                try:
-                    _send_msg(sock, req)
-                    payload = _recv_msg(sock)
-                except BaseException as e:
-                    try:
-                        sock.close()
-                    except OSError:
-                        pass
-                    # a socket parked idle in the pool can be dropped by
-                    # the peer/NAT at any time; retry immediately on a
-                    # fresh connection without consuming an attempt — but
-                    # NEVER when the watchdog severed it: its one-shot
-                    # round-trip deadline is already spent, and a silent
-                    # fresh-connection retry would face the dribbling peer
-                    # unguarded (the unbounded hang the guard exists for)
-                    severed = _sock_cell is not None and _sock_cell.get("severed")
-                    if (
-                        from_pool
-                        and not severed
-                        and isinstance(e, (ConnectionError, OSError))
-                    ):
-                        continue
-                    raise
-                else:
-                    self._pool.release(rank, sock)
-                    return payload
-
-        return call_with_retries(
-            attempt_once,
-            policy=policy,
-            retry_on=(ConnectionError, OSError),
-            describe=f"shard fetch from {host}:{port}",
-            hint="HYDRAGNN_STORE_RETRIES tunes the cap",
+        return self._rt.request(
+            rank, host, port, policy=policy, _sock_cell=_sock_cell, **fields
         )
 
     def _failover_request(self, owner_ranks, fields_for, what: str):
@@ -1042,35 +649,14 @@ class ShardedStore:
         )
 
     def _guard_round_trip(self, host: str, port: int, cell: dict):
-        """Watchdog context for one replica round-trip: if the round-trip
-        outlives ~1.25x the peer timeout (the per-recv socket timeout never
-        fired — a dribbling peer), the monitor thread severs the in-flight
-        socket, converting the hang into the OSError the failover path
-        already handles. Disabled for non-finite/zero timeouts."""
-        from contextlib import nullcontext
-
-        if not (self.peer_timeout and np.isfinite(self.peer_timeout)):
-            return nullcontext()
-        if self._watchdog is None:
-            from ..resilience.watchdog import Watchdog
-
-            self._watchdog = Watchdog(self.peer_timeout * 1.25)
-
-        def sever() -> None:
-            # flag BEFORE closing: the blocked recv wakes the instant the
-            # socket dies, and the error path must already see "severed"
-            # (a severed pooled socket is a spent deadline, not a stale
-            # socket to quietly retry)
-            cell["severed"] = True
-            sock = cell.get("sock")
-            if sock is not None:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-
-        return self._watchdog.guard(
-            f"shard round-trip to {host}:{port}", on_expire=sever
+        """Watchdog context for one replica round-trip
+        (``utils.wire.RoundTripper.guard``): if the round-trip outlives
+        ~1.25x the peer timeout (the per-recv socket timeout never fired —
+        a dribbling peer), the monitor thread severs the in-flight socket,
+        converting the hang into the OSError the failover path already
+        handles. Disabled for non-finite/zero timeouts."""
+        return self._rt.guard(
+            host, port, cell, what=f"shard round-trip to {host}:{port}"
         )
 
     @staticmethod

@@ -20,6 +20,15 @@ from .admission import (  # noqa: F401
     UnknownModelError,
 )
 from .batcher import MicroBatcher, canonical_meta, serving_collate  # noqa: F401
+from .fleet import (  # noqa: F401
+    AnswerCache,
+    FleetConfig,
+    FleetRouter,
+    ReplicaHost,
+    answer_key,
+    fleet_config_defaults,
+    spawn_replica,
+)
 from .predictor import Predictor  # noqa: F401
 from .quant import QuantizationError  # noqa: F401
 from .server import (  # noqa: F401
@@ -28,11 +37,19 @@ from .server import (  # noqa: F401
     ServingConfig,
     serving_config_defaults,
 )
-from .traffic import TrafficReport, run_traffic  # noqa: F401
+from .traffic import (  # noqa: F401
+    TrafficReport,
+    mixed_priority_plan,
+    run_traffic,
+    zipf_duplicate_order,
+)
 
 __all__ = [
     "AdmissionError",
+    "AnswerCache",
     "DeadlineExceededError",
+    "FleetConfig",
+    "FleetRouter",
     "IncompatibleSampleError",
     "MicroBatcher",
     "ModelEndpoint",
@@ -41,14 +58,20 @@ __all__ = [
     "Predictor",
     "QuantizationError",
     "QueueFullError",
+    "ReplicaHost",
     "Request",
     "RequestQueue",
     "ServerClosedError",
     "ServingConfig",
     "TrafficReport",
     "UnknownModelError",
+    "answer_key",
     "canonical_meta",
+    "fleet_config_defaults",
+    "mixed_priority_plan",
     "run_traffic",
     "serving_collate",
     "serving_config_defaults",
+    "spawn_replica",
+    "zipf_duplicate_order",
 ]

@@ -1,0 +1,55 @@
+"""Fleet serving: a multi-process RPC front end over ``PredictionServer``.
+
+N replica processes (``replica.py`` — each a ``PredictionServer`` booted
+from checkpoint paths alone, AOT-warmed before it advertises ready) behind
+one :class:`~hydragnn_tpu.serve.fleet.router.FleetRouter` speaking the
+shared ``utils.wire`` transport (the SAME framing/auth/watchdog machinery
+as the elastic data plane — one transport, not two). The router adds
+request-priority classes with per-class queue budgets and deadline-aware
+shedding, least-loaded dispatch, health-checked failover (the PR 4
+quarantine + doubling re-probe pattern, applied to inference replicas),
+and a content-addressed answer cache so duplicate graphs under heavy
+traffic cost zero replica compute.
+
+Attribute access is lazy (PEP 562): ``serve.server`` imports this
+package's ``config`` submodule at module level, and an eager router
+import here would close an import cycle back into ``serve.server``.
+"""
+
+from .config import FleetConfig, PRIORITY_CLASSES, fleet_config_defaults  # noqa: F401
+
+_LAZY = {
+    "AnswerCache": ".cache",
+    "answer_key": ".cache",
+    "canonical_sample_bytes": ".cache",
+    "FleetRouter": ".router",
+    "ReplicaHost": ".replica",
+    "ReplicaProcess": ".replica",
+    "spawn_replica": ".replica",
+    "worker_main": ".replica",
+    "write_samples_file": ".replica",
+}
+
+__all__ = [
+    "AnswerCache",
+    "FleetConfig",
+    "FleetRouter",
+    "PRIORITY_CLASSES",
+    "ReplicaHost",
+    "ReplicaProcess",
+    "answer_key",
+    "canonical_sample_bytes",
+    "fleet_config_defaults",
+    "spawn_replica",
+    "worker_main",
+    "write_samples_file",
+]
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod, __name__), name)

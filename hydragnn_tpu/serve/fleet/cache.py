@@ -1,0 +1,133 @@
+"""Content-addressed answer cache for the fleet router.
+
+Key = SHA-256 over the CANONICALIZED graph bytes + model name + quant
+flag. Canonicalization reuses the wire codec (``utils.wire``): the
+sample's arrays, key-sorted, packed with their dtype/shape specs — two
+requests carrying the same molecule produce the same bytes regardless of
+dict insertion order or array contiguity, and two molecules differing in
+any feature bit produce different bytes (the codec frames raw array
+bytes, so the digest covers every value exactly; no float rounding, no
+summary hashing).
+
+The cache is a byte-budgeted LRU: entries are charged the sum of their
+per-head array bytes (plus key overhead), and inserts evict from the
+cold end until the budget holds. Both ``put`` and ``get`` deep-copy —
+the cache's instance stays pristine no matter what callers do to theirs
+(the ADVICE r5 aliasing lesson), which is what lets the hit-path answers
+stay BYTE-IDENTICAL to replica compute forever.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ...graphs.graph import GraphSample
+from ...utils import wire
+
+
+def canonical_sample_bytes(sample: GraphSample) -> bytes:
+    """The content-address preimage of one graph: its wire arrays in
+    sorted key order (``pack_arrays`` covers name + dtype + shape + raw
+    bytes per array, so any difference in any field changes the bytes)."""
+    return wire.pack_arrays(dict(sorted(wire.sample_to_arrays(sample).items())))
+
+
+def answer_key(sample: GraphSample, model: str, quantized: bool = False) -> str:
+    """Digest of (canonical graph bytes, model name, quant flag). The
+    quant flag is part of the address: an int8 answer and an fp32 answer
+    for the same graph are DIFFERENT answers, and a fleet that flips
+    quantization must never serve stale cross-mode hits."""
+    h = hashlib.sha256()
+    h.update(canonical_sample_bytes(sample))
+    h.update(b"\x00model:")
+    h.update(model.encode())
+    h.update(b"\x00quant:1" if quantized else b"\x00quant:0")
+    return h.hexdigest()
+
+
+class AnswerCache:
+    """Byte-budgeted LRU of per-request head answers, keyed by
+    :func:`answer_key`. Thread-safe; array copies happen OUTSIDE the lock
+    (the lock serializes bookkeeping only, so dispatcher threads don't
+    stall each other on memcpy)."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, tuple[list[np.ndarray], int]]" = (
+            OrderedDict()
+        )
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+        self.oversize_skips = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @staticmethod
+    def _cost(key: str, heads: list[np.ndarray]) -> int:
+        return sum(int(a.nbytes) for a in heads) + len(key)
+
+    def get(self, key: str) -> "list[np.ndarray] | None":
+        """The cached heads (fresh writable copies) or None. A hit
+        promotes the entry to the hot end."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            heads = entry[0]  # reference only under the lock
+        return [np.array(a) for a in heads]
+
+    def put(self, key: str, heads: "list[np.ndarray]") -> bool:
+        """Insert (a pristine copy of) one answer; False when the cache is
+        disabled (budget 0) or the single answer exceeds the whole budget
+        (caching it would just evict everything else for one entry)."""
+        if self.budget_bytes <= 0:
+            return False
+        copies = [np.array(a) for a in heads]
+        cost = self._cost(key, copies)
+        if cost > self.budget_bytes:
+            with self._lock:
+                self.oversize_skips += 1
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes -= old[1]
+            self._entries[key] = (copies, cost)
+            self.bytes += cost
+            self.insertions += 1
+            while self.bytes > self.budget_bytes and self._entries:
+                _, (_, evicted_cost) = self._entries.popitem(last=False)
+                self.bytes -= evicted_cost
+                self.evictions += 1
+        return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "budget_bytes": self.budget_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else None,
+                "insertions": self.insertions,
+                "evictions": self.evictions,
+                "oversize_skips": self.oversize_skips,
+            }
+
+
+__all__ = ["AnswerCache", "answer_key", "canonical_sample_bytes"]

@@ -1,0 +1,161 @@
+"""The ``Serving.fleet`` config block, single-sourced from one dataclass.
+
+Same pattern as ``StoreConfig`` (``Dataset.store``) and ``ServingConfig``
+(``Serving``): the :class:`FleetConfig` field defaults ARE the schema
+defaults (``config.update_config`` fills the nested block from
+``fleet_config_defaults`` and validates it through ``validate()``), and
+the ``HYDRAGNN_FLEET_*`` env flags override at router construction.
+
+Deliberately import-light (stdlib + the flag registry only): the config
+schema validates this block at config-load time, long before any model —
+or even jax — is imported.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Fleet-router knobs.
+
+    * ``replicas`` — how many replica processes a fleet deployment boots
+      (``HYDRAGNN_FLEET_REPLICAS`` overrides; the router itself serves
+      however many replicas are attached — this knob sizes deployments
+      and the bench/test topologies).
+    * ``budget_interactive`` / ``budget_batch`` / ``budget_best_effort`` —
+      per-priority-class admission queue budgets. A class at budget sheds
+      NEW arrivals of that class with a typed ``QueueFullError`` while the
+      other classes keep admitting — under overload best-effort saturates
+      and sheds first, interactive keeps flowing.
+    * ``cache_bytes`` — byte budget of the router's content-addressed
+      answer cache (0 disables; ``HYDRAGNN_FLEET_CACHE_BYTES`` overrides).
+      Keyed on canonicalized graph bytes + model + quant flag, so
+      duplicate molecules under heavy traffic cost zero replica compute.
+    * ``auth`` — shared-secret token stamped on every replica round-trip
+      (same misconfiguration-guard trust model as ``ShardServer``; an
+      auth mismatch is LOUD, never failed over).
+    * ``peer_timeout`` — connect/read deadline per replica socket; the
+      watchdog severs round-trips at ~1.25x this, so even a
+      byte-dribbling replica cannot park a request.
+    * ``probe_interval`` / ``quarantine_base_s`` / ``quarantine_cap_s`` —
+      the PR 4 quarantine + doubling re-probe clock, applied to inference
+      replicas instead of shard owners.
+    * ``inflight_per_replica`` — concurrent round-trips the router keeps
+      open per replica (the replica's own micro-batcher coalesces them);
+      also bounds the dispatch window that least-loaded routing balances.
+    """
+
+    replicas: int = 2
+    budget_interactive: int = 256
+    budget_batch: int = 128
+    budget_best_effort: int = 64
+    cache_bytes: int = 33_554_432  # 32 MiB
+    auth: str | None = None
+    peer_timeout: float = 30.0
+    probe_interval: float = 0.5
+    quarantine_base_s: float = 0.5
+    quarantine_cap_s: float = 8.0
+    inflight_per_replica: int = 2
+
+    @staticmethod
+    def from_config(config: "dict | FleetConfig | None") -> "FleetConfig":
+        """Accepts a FleetConfig (copied), a full config dict (reads
+        ``Serving.fleet``, absent = defaults), the ``Serving`` block, or
+        the fleet block itself — recognized by its field names; unknown
+        fields raise instead of silently falling back to defaults."""
+        if isinstance(config, FleetConfig):
+            return dataclasses.replace(config).apply_env()
+        config = config or {}
+        if "Serving" in config:
+            # full config: its Serving.fleet block, absent = defaults
+            serving = config["Serving"]
+            if not isinstance(serving, dict):
+                raise ValueError(
+                    f"Serving must be a dict, got {type(serving).__name__}"
+                )
+            block = serving.get("fleet") or {}
+        elif "fleet" in config:
+            block = config["fleet"]  # the Serving block itself
+        else:
+            # the fleet block directly — recognized by its field names, so
+            # a typo'd block raises instead of silently using defaults
+            known = fleet_config_defaults()
+            if config and not any(k in known for k in config):
+                raise ValueError(
+                    f"unrecognized fleet config keys {sorted(config)}; "
+                    f"expected Serving.fleet fields {sorted(known)}"
+                )
+            block = config
+        if not isinstance(block, dict):
+            raise ValueError(
+                f"Serving.fleet must be a dict, got {type(block).__name__}"
+            )
+        return FleetConfig(**block).apply_env()
+
+    def apply_env(self) -> "FleetConfig":
+        """Fold ``HYDRAGNN_FLEET_*`` overrides in (idempotent)."""
+        from ...utils import flags
+
+        n = flags.get(flags.FLEET_REPLICAS)
+        if n is not None:
+            self.replicas = int(n)
+        b = flags.get(flags.FLEET_CACHE_BYTES)
+        if b is not None:
+            self.cache_bytes = int(b)
+        return self
+
+    def validate(self) -> "FleetConfig":
+        """Range-check every field; the ONE implementation behind both the
+        schema's nested ``Serving.fleet`` validation and direct router
+        construction."""
+        if int(self.replicas) < 1:
+            raise ValueError(
+                f"Serving.fleet.replicas must be >= 1, got {self.replicas}"
+            )
+        for cls in PRIORITY_CLASSES:
+            key = f"budget_{cls}"
+            if int(getattr(self, key)) < 1:
+                raise ValueError(
+                    f"Serving.fleet.{key} must be >= 1, got "
+                    f"{getattr(self, key)}"
+                )
+        if int(self.cache_bytes) < 0:
+            raise ValueError(
+                "Serving.fleet.cache_bytes must be >= 0 (0 disables the "
+                f"answer cache), got {self.cache_bytes}"
+            )
+        if self.auth is not None and not isinstance(self.auth, str):
+            raise ValueError(
+                f"Serving.fleet.auth must be a string token or null, got "
+                f"{type(self.auth).__name__}"
+            )
+        for key in ("peer_timeout", "probe_interval", "quarantine_base_s",
+                    "quarantine_cap_s"):
+            if float(getattr(self, key)) <= 0:
+                raise ValueError(
+                    f"Serving.fleet.{key} must be > 0, got "
+                    f"{getattr(self, key)}"
+                )
+        if int(self.inflight_per_replica) < 1:
+            raise ValueError(
+                "Serving.fleet.inflight_per_replica must be >= 1, got "
+                f"{self.inflight_per_replica}"
+            )
+        return self
+
+    def budget(self, priority: str) -> int:
+        return int(getattr(self, f"budget_{priority}"))
+
+
+def fleet_config_defaults() -> dict:
+    """``{config key: default}`` for the ``Serving.fleet`` block — derived
+    from ``dataclasses.fields`` so a future field cannot silently drop out
+    of the schema/validation plumbing."""
+    return {f.name: f.default for f in dataclasses.fields(FleetConfig)}
+
+
+__all__ = ["FleetConfig", "PRIORITY_CLASSES", "fleet_config_defaults"]

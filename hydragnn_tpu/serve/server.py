@@ -48,6 +48,7 @@ from .admission import (
     UnknownModelError,
 )
 from .batcher import MicroBatcher, serving_collate
+from .fleet.config import FleetConfig, fleet_config_defaults
 from .predictor import Predictor
 
 
@@ -78,6 +79,14 @@ class ServingConfig:
     quantize: bool = False
     quant_tol: float = 0.1       # per-head max abs error ceiling vs fp32
     quant_calib_batches: int = 4  # calibration batches per (model, bucket)
+    # fleet front end (serve/fleet): the nested Serving.fleet block —
+    # replicas / per-class budgets / cache_bytes / auth — single-sourced
+    # from the FleetConfig dataclass (fleet/config.py) and validated
+    # through it below. The in-process PredictionServer ignores it; the
+    # FleetRouter reads it via FleetConfig.from_config(full config).
+    fleet: dict = dataclasses.field(
+        default_factory=lambda: fleet_config_defaults()
+    )
 
     @staticmethod
     def from_config(config: dict | None) -> "ServingConfig":
@@ -152,6 +161,17 @@ class ServingConfig:
                 "the error-bound gate run at warm-up — without it the "
                 "server would silently serve fp32 despite quantize=true"
             )
+        if not isinstance(self.fleet, dict):
+            raise ValueError(
+                f"Serving.fleet must be a dict, got {type(self.fleet).__name__}"
+            )
+        unknown = set(self.fleet) - set(fleet_config_defaults())
+        if unknown:
+            raise ValueError(
+                f"Unknown Serving.fleet key(s) {sorted(unknown)}; known: "
+                f"{sorted(fleet_config_defaults())}"
+            )
+        FleetConfig(**self.fleet).validate()  # one range-check impl
         return self
 
 

@@ -175,6 +175,20 @@ SERVE_QUANT = _register(Flag(
     "variant ALONGSIDE the fp32 one, and refuses to boot if any head's "
     "calibrated error vs the fp32 answer exceeds Serving.quant_tol. =0 "
     "serves the fp32 executables only (bit-identical to run_prediction)."))
+FLEET_REPLICAS = _register(Flag(
+    "HYDRAGNN_FLEET_REPLICAS", "int", None,
+    "Replica processes a fleet deployment boots behind the router "
+    "(overrides Serving.fleet.replicas, default 2). Each replica is a "
+    "subprocess PredictionServer booted from checkpoint paths, AOT-warmed "
+    "before it advertises ready; the router health-checks them and fails "
+    "a dead/dribbling replica's in-flight requests over transparently."))
+FLEET_CACHE_BYTES = _register(Flag(
+    "HYDRAGNN_FLEET_CACHE_BYTES", "int", None,
+    "Byte budget of the fleet router's content-addressed answer cache "
+    "(overrides Serving.fleet.cache_bytes, default 32 MiB; =0 disables). "
+    "Keyed on canonicalized graph bytes + model + quant flag: a repeated "
+    "graph is answered from the router, byte-identical to replica "
+    "compute, at zero replica cost."))
 
 # -- kernels / compilation --------------------------------------------------
 FUSED_SCATTER = _register(Flag(

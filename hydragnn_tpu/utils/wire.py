@@ -1,0 +1,790 @@
+"""One wire protocol for every TCP tier of the repo.
+
+PR 4 grew a length-prefixed binary frame protocol inside
+``datasets/sharded.py`` for the elastic data plane: ``pack_arrays`` /
+``unpack_arrays`` codec (no pickle anywhere — object dtypes rejected on
+both ends), an HMAC-compared auth token, pooled per-peer sockets, and
+watchdog-bracketed round-trips that sever byte-dribbling peers. The fleet
+serving tier (``serve/fleet``) needs the identical transport in front of
+prediction replicas, so this module is the single implementation — one
+transport, not two:
+
+* **framing + codec** — ``send_msg``/``recv_msg`` length-prefixed frames of
+  ``pack_arrays`` dict-of-ndarray payloads; zero-copy ``np.frombuffer``
+  decode, every length validated before slicing;
+* **sample codec** — ``GraphSample`` <-> flat array dict (the ShardedStore
+  fetch payload and the fleet's predict request payload);
+* **auth** — ``token_field``/``token_ok``: a shared-secret MISCONFIGURATION
+  guard (plaintext + replayable — see the trust-model note in
+  ``datasets/sharded.py``), compared with ``hmac.compare_digest`` so the
+  guard itself doesn't leak the token through timing;
+* **ping/pong** — ``pong_frame`` (server) + ``check_pong`` (client): ONE
+  pong-validation implementation shared by the ShardedStore re-probe
+  prober and the fleet health prober, each validating the identity fields
+  it advertised the peer under (range for shards, readiness for replicas)
+  before trusting it again — previously each prober carried its own
+  inline validation loop;
+* **ConnPool / RoundTripper** — pooled per-peer sockets with the
+  stale-pool retry discipline, and the watchdog deadline bracketing every
+  round-trip so a peer that dribbles bytes (resetting the per-``recv``
+  socket timeout forever) is severed from the monitor thread and surfaces
+  as an ordinary connection error;
+* **WireServer** — the threaded TCP server shell (conn registry,
+  instant dead-host ``close()``, malformed-frame drop, auth check, ping
+  answer, server-error records) that ``ShardServer`` and the fleet's
+  ``ReplicaHost`` both subclass;
+* **HealthTable** — the quarantine clock (doubling re-probe backoff,
+  healthy-first rotated replica ordering) shared by ShardedStore failover
+  and fleet replica failover.
+"""
+
+from __future__ import annotations
+
+import hmac
+import socket
+import socketserver
+import struct
+import sys
+import threading
+import time
+
+import numpy as np
+
+from ..graphs.graph import GraphSample
+
+HDR = struct.Struct("<q")  # payload byte length
+MAGIC = b"GSX1"
+
+
+# -- framing + array codec ----------------------------------------------------
+
+
+def send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(HDR.pack(len(payload)) + payload)
+
+
+def pack_arrays(d: dict[str, np.ndarray]) -> bytes:
+    """dict[str, ndarray] -> compact binary frame. ~50x faster than ``.npz``
+    (zipfile is pure Python and dominated the TCP tier's CPU budget); the
+    dtype travels as its ``.str`` spec, never as a pickled object."""
+    parts = [MAGIC, struct.pack("<I", len(d))]
+    for k, v in d.items():
+        v = np.ascontiguousarray(v)
+        if v.dtype.hasobject:
+            raise ValueError("object arrays are not allowed on the wire")
+        name = k.encode()
+        dt = v.dtype.str.encode()
+        parts.append(struct.pack("<H", len(name)))
+        parts.append(name)
+        parts.append(struct.pack("<B", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<B", v.ndim))
+        if v.ndim:
+            parts.append(struct.pack(f"<{v.ndim}q", *v.shape))
+        raw = v.tobytes()
+        parts.append(struct.pack("<q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def unpack_arrays(buf: bytes) -> dict[str, np.ndarray]:
+    """Inverse of ``pack_arrays``; arrays are zero-copy views into ``buf``.
+    Every length is validated against the payload before slicing, and ANY
+    malformed frame — bad magic, truncated header, unknown dtype — raises
+    ``ValueError`` (never struct.error/TypeError leaking to callers)."""
+    try:
+        if buf[:4] != MAGIC:
+            raise ValueError(
+                "bad wire magic (peer speaks a different protocol?)"
+            )
+        mv = memoryview(buf)
+        off = 4
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        out: dict[str, np.ndarray] = {}
+        for _ in range(n):
+            (nl,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            if off + nl > len(buf):
+                raise ValueError("truncated frame (name)")
+            name = bytes(mv[off:off + nl]).decode()
+            off += nl
+            (dl,) = struct.unpack_from("<B", buf, off)
+            off += 1
+            if off + dl > len(buf):
+                raise ValueError("truncated frame (dtype)")
+            dt = np.dtype(bytes(mv[off:off + dl]).decode())
+            off += dl
+            if dt.hasobject:
+                raise ValueError("object arrays are not allowed on the wire")
+            (nd,) = struct.unpack_from("<B", buf, off)
+            off += 1
+            shape = struct.unpack_from(f"<{nd}q", buf, off) if nd else ()
+            off += 8 * nd
+            (nb,) = struct.unpack_from("<q", buf, off)
+            off += 8
+            count = int(np.prod(shape, dtype=np.int64)) if nd else 1
+            if count < 0 or nb != count * dt.itemsize or off + nb > len(buf):
+                raise ValueError(f"corrupt frame for array {name!r}")
+            out[name] = np.frombuffer(mv[off:off + nb], dtype=dt).reshape(shape)
+            off += nb
+        return out
+    except ValueError:
+        raise
+    except (struct.error, TypeError, UnicodeDecodeError) as e:
+        raise ValueError(f"corrupt frame: {e}") from None
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> bytes:
+    (n,) = HDR.unpack(recv_exact(sock, HDR.size))
+    if n < 0 or n > (1 << 33):
+        raise ValueError(f"bad message length {n}")
+    return recv_exact(sock, n)
+
+
+# -- text / token fields ------------------------------------------------------
+
+
+def text_field(s: str) -> np.ndarray:
+    """UTF-8 text as a uint8 array — the only way strings travel (the codec
+    carries arrays only; pickled str objects never touch the wire)."""
+    return np.frombuffer(s.encode(), np.uint8)
+
+
+def field_text(v: np.ndarray | None, default: str = "") -> str:
+    if v is None:
+        return default
+    return bytes(np.asarray(v, np.uint8)).decode(errors="replace")
+
+
+def token_field(token: str) -> np.ndarray:
+    return np.frombuffer(token.encode(), np.uint8)
+
+
+def token_ok(frame: dict[str, np.ndarray], token: bytes | None) -> bool:
+    """Server-side auth check: True when no token is configured or the
+    frame carries a matching one. ``hmac.compare_digest`` so the guard
+    itself doesn't leak the token byte-by-byte through timing."""
+    if token is None:
+        return True
+    got = frame.get("token")
+    return got is not None and hmac.compare_digest(
+        np.asarray(got).tobytes(), token
+    )
+
+
+# -- GraphSample <-> flat dict of arrays (npz-safe: no object dtypes) ---------
+
+_ARRAY_FIELDS = (
+    "x", "pos", "senders", "receivers", "edge_attr", "edge_shifts",
+    "graph_y", "node_y", "energy_y", "forces_y", "graph_attr",
+)
+_EXTRA_FIELDS = ("node_table", "graph_table")
+# extras that ride the serving plane (PE / triplet indices are part of the
+# endpoint signature; a request stripped of them would be shed or served
+# angle/PE-blind)
+_WIRE_EXTRAS = ("pe", "rel_pe", "idx_kj", "idx_ji")
+
+
+def sample_to_arrays(s: GraphSample) -> dict[str, np.ndarray]:
+    out = {}
+    for f in _ARRAY_FIELDS:
+        v = getattr(s, f)
+        if v is not None:
+            out[f] = np.asarray(v)
+    for f in _EXTRA_FIELDS + _WIRE_EXTRAS:
+        if f in s.extras:
+            out["extra_" + f] = np.asarray(s.extras[f])
+    out["dataset_id"] = np.asarray(s.dataset_id, np.int32)
+    return out
+
+
+def sample_from_arrays(d: dict[str, np.ndarray]) -> GraphSample:
+    # np.array: decoded frames are read-only frombuffer views; samples must
+    # be writable (downstream transforms mutate in place)
+    kw = {f: np.array(d[f]) for f in _ARRAY_FIELDS if f in d}
+    s = GraphSample(dataset_id=int(d["dataset_id"]), **kw)
+    for f in _EXTRA_FIELDS + _WIRE_EXTRAS:
+        if "extra_" + f in d:
+            s.extras[f] = np.array(d["extra_" + f])
+    return s
+
+
+def copy_sample(s: GraphSample) -> GraphSample:
+    """Independent deep-ish copy: fresh array buffers, fresh extras dict.
+    Caches hand these out because downstream transforms mutate samples in
+    place — a cache that returns its own instances corrupts every later
+    hit of the same index (ADVICE.md r5)."""
+    out = GraphSample.__new__(GraphSample)
+    for f in GraphSample.__slots__:
+        v = getattr(s, f)
+        if isinstance(v, np.ndarray):
+            v = v.copy()
+        elif f == "extras":
+            v = {
+                k: (x.copy() if isinstance(x, np.ndarray) else x)
+                for k, x in v.items()
+            }
+        setattr(out, f, v)
+    return out
+
+
+def encode_samples(samples: list[GraphSample]) -> bytes:
+    return pack_arrays(sample_fields(samples))
+
+
+def sample_fields(samples: list[GraphSample]) -> dict[str, np.ndarray]:
+    """The flat ``s{i}_*`` field layout of a samples frame — exposed (not
+    just ``encode_samples``) so a request can carry samples NEXT TO other
+    routing fields (model name, op markers) in one frame."""
+    flat: dict[str, np.ndarray] = {}
+    for i, s in enumerate(samples):
+        for k, v in sample_to_arrays(s).items():
+            flat[f"s{i}_{k}"] = v
+    flat["n"] = np.asarray(len(samples), np.int64)
+    return flat
+
+
+def samples_from_frame(z: dict[str, np.ndarray]) -> list[GraphSample]:
+    n = int(z["n"])
+    out = []
+    for i in range(n):
+        prefix = f"s{i}_"
+        d = {k[len(prefix):]: v for k, v in z.items() if k.startswith(prefix)}
+        out.append(sample_from_arrays(d))
+    return out
+
+
+# -- ping / pong --------------------------------------------------------------
+
+
+def pong_frame(**fields: np.ndarray) -> bytes:
+    """The server half of a health probe: ``{"n": 0, "pong": 1}`` plus the
+    identity fields the prober validates (a shard's served range, a
+    replica's readiness bit + model list)."""
+    out = {"n": np.asarray(0, np.int64), "pong": np.asarray(1, np.int64)}
+    out.update(fields)
+    return pack_arrays(out)
+
+
+def check_pong(z: dict[str, np.ndarray], what: str, **expect) -> None:
+    """THE pong validation (client half), shared by the ShardedStore
+    re-probe prober and the fleet health prober — two inline copies of
+    this loop would silently diverge the first time the policy is tuned.
+    Every ``expect`` field must be present in the pong and match exactly
+    (``np.array_equal`` after int64 coercion); a missing/mismatched field
+    raises ``ConnectionError`` so the caller's quarantine stays armed — a
+    peer reborn with a different identity must never be resurrected into
+    the address its peers advertise."""
+    if int(np.asarray(z.get("pong", 0)).reshape(-1)[0] if "pong" in z else 0) != 1:
+        raise ConnectionError(f"{what}: peer answered without a pong")
+    for key, want in expect.items():
+        got = z.get(key)
+        want = np.asarray(want, np.int64)
+        if got is None or not np.array_equal(
+            np.asarray(got, np.int64).reshape(-1), want.reshape(-1)
+        ):
+            raise ConnectionError(
+                f"{what}: pong advertises {key}="
+                f"{None if got is None else np.asarray(got).tolist()}, "
+                f"expected {want.tolist()}"
+            )
+
+
+def error_frame(code: int, detail: str | None = None) -> bytes:
+    fields = {"n": np.asarray(int(code), np.int64)}
+    if detail:
+        fields["detail"] = np.frombuffer(detail.encode()[:512], np.uint8)
+    return pack_arrays(fields)
+
+
+def frame_detail(z: dict[str, np.ndarray]) -> str:
+    return bytes(np.asarray(z.get("detail", []), np.uint8)).decode(
+        errors="replace"
+    )
+
+
+# -- server shell -------------------------------------------------------------
+
+
+class WireServer:
+    """Threaded TCP server answering ``pack_arrays`` frames — the shell
+    ``ShardServer`` (sample fetches) and the fleet ``ReplicaHost``
+    (predictions) share. Handles, in order, for every request frame: the
+    chaos/test delay knob, the auth-token check (``n=-2`` record on
+    mismatch), ``ping`` (``pong_frame(**self.pong_fields())``), then
+    delegates to :meth:`handle_frame`; an exception out of the handler
+    becomes an ``n=-3`` error record telling the CLIENT what broke instead
+    of closing with no diagnostics.
+
+    ``close()`` stops serving LIKE A DEAD HOST: immediately (no
+    shutdown-poll wait — a chaos kill inside a timed window must not bill
+    the victim's teardown to the client) and completely — the listening
+    socket AND every established connection are severed, so pooled client
+    sockets error on reuse instead of being silently served by a 'dead'
+    peer. ``port=0`` picks an ephemeral port; a fixed port lets a
+    restarted host come back at the address its peers already advertise,
+    so a prober's quarantine-lift finds it."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 auth_token: str | None = None, name: str | None = None,
+                 _test_delay_s: float = 0.0):
+        outer = self
+        tok = None if auth_token is None else auth_token.encode()
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                with outer._conns_lock:
+                    # registration and the close() snapshot share one lock:
+                    # a connection either lands in the snapshot (severed by
+                    # close) or observes closed here — no window where a
+                    # just-accepted socket outlives the "dead" host
+                    if outer.closed:
+                        return
+                    outer._conns.add(self.request)
+                try:
+                    self._serve_requests()
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
+
+            def _serve_requests(self) -> None:
+                try:
+                    while True:
+                        try:
+                            z = unpack_arrays(recv_msg(self.request))
+                        except ValueError:
+                            # malformed frame: drop the connection — one
+                            # line of diagnostics, no per-request traceback
+                            # spam from a misbehaving peer
+                            print(
+                                f"[{outer._log_name()}] dropping peer "
+                                f"{self.client_address}: malformed frame",
+                                file=sys.stderr,
+                            )
+                            return
+                        if outer._test_delay_s:
+                            time.sleep(outer._test_delay_s)
+                        if not token_ok(z, tok):
+                            send_msg(self.request, error_frame(-2))
+                            continue
+                        if "ping" in z:
+                            # health probe (piggybacked on the request
+                            # protocol): answer with the identity fields a
+                            # prober verifies before lifting a quarantine
+                            send_msg(
+                                self.request,
+                                pong_frame(**outer.pong_fields()),
+                            )
+                            continue
+                        try:
+                            resp = outer.handle_frame(z)
+                            if isinstance(resp, dict):
+                                resp = pack_arrays(resp)
+                        except Exception as e:
+                            # server-side failure: tell the CLIENT what
+                            # broke instead of closing with no diagnostics
+                            resp = error_frame(
+                                -3, f"{type(e).__name__}: {e}"
+                            )
+                        send_msg(self.request, resp)
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._name = name or type(self).__name__
+        self._test_delay_s = float(_test_delay_s)
+        self._conns: set[socket.socket] = set()  # live handler sockets
+        self._conns_lock = threading.Lock()
+        self._srv = Server((host, int(port)), Handler)
+        self.port = self._srv.server_address[1]
+        self.closed = False
+
+        def _serve() -> None:
+            try:
+                self._srv.serve_forever()
+            except Exception:
+                # close() severs the listening socket out from under the
+                # select loop for an IMMEDIATE stop; the resulting EBADF
+                # is the expected way down, anything else is real
+                if not self.closed:
+                    raise
+
+        self._thread = threading.Thread(target=_serve, daemon=True)
+        self._thread.start()
+
+    # -- subclass hooks --
+    def pong_fields(self) -> dict[str, np.ndarray]:
+        """Identity fields the ping response advertises (and probers
+        validate via :func:`check_pong`)."""
+        return {}
+
+    def handle_frame(self, z: dict[str, np.ndarray]) -> "bytes | dict":
+        raise NotImplementedError
+
+    # -- chaos / lifecycle --
+    def _log_name(self) -> str:
+        return f"{self._name}:{self.port}"
+
+    def set_delay(self, seconds: float) -> None:
+        """Delay every response by ``seconds`` — the chaos ``slow_peer``
+        hook: a response slower than the client's peer timeout makes this
+        server a gray failure that callers must fail over around."""
+        self._test_delay_s = float(seconds)
+
+    def close(self) -> None:
+        with self._conns_lock:
+            if self.closed:
+                return
+            self.closed = True
+            conns = list(self._conns)
+        self._srv.server_close()  # refuses new connects from this instant
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        # reap the serve loop off-thread: BaseServer.shutdown() blocks up
+        # to its 0.5s poll interval, which callers should never pay
+        threading.Thread(target=self._srv.shutdown, daemon=True).start()
+
+
+# -- client: pooled sockets + watchdog-bracketed round-trips ------------------
+
+
+class ConnPool:
+    """Per-peer socket pool. Each concurrent caller checks out its own
+    socket (creating one when none is idle), runs its request/response
+    round-trip WITHOUT any shared lock, and returns the socket afterwards —
+    so N workers overlap N remote round-trips. Idle sockets per peer are
+    capped; excess ones close on release."""
+
+    def __init__(self, max_idle_per_peer: int = 4, timeout: float = 120.0):
+        self._idle: dict[object, list[socket.socket]] = {}
+        self._lock = threading.Lock()
+        self._max_idle = int(max_idle_per_peer)
+        self._closed = False
+        self.timeout = float(timeout)  # connect AND per-recv deadline
+
+    def acquire(self, key, host: str, port: int) -> tuple[socket.socket, bool]:
+        """Returns (socket, from_pool). A pooled socket may have gone stale
+        while idle — callers retry once on a fresh one; a FRESH connection
+        failing is a real error. ``self.timeout`` bounds both the connect
+        AND every later recv on the socket (``create_connection`` leaves
+        its timeout armed), so a hung peer surfaces as ``socket.timeout`` —
+        an ``OSError`` failover paths treat as peer-down — instead of
+        parking the caller forever."""
+        # <=0 means NO deadline (blocking), matching the round-trip guard's
+        # "disabled for zero timeouts" convention — socket timeout 0.0 is
+        # Python's NON-BLOCKING mode, which would instantly fail every
+        # connect with BlockingIOError and quarantine healthy peers
+        timeout = self.timeout if self.timeout and self.timeout > 0 else None
+        with self._lock:
+            stack = self._idle.get(key)
+            while stack:
+                sock = stack.pop()
+                try:
+                    sock.settimeout(timeout)  # policy may have changed
+                except OSError:
+                    continue  # closed while parked: discard, try the next
+                return sock, True
+        return socket.create_connection((host, port), timeout=timeout), False
+
+    def release(self, key, sock: socket.socket) -> None:
+        with self._lock:
+            # a release racing close() (in-flight round-trip during
+            # teardown) must not re-park into the cleared pool — close it
+            if not self._closed:
+                stack = self._idle.setdefault(key, [])
+                if len(stack) < self._max_idle:
+                    stack.append(sock)
+                    return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def evict(self, key) -> None:
+        """Close and drop every idle socket pooled for ``key`` — called
+        when a peer is quarantined, so a later un-quarantine never checks
+        out a socket that spent the whole outage parked half-dead."""
+        with self._lock:
+            stack = self._idle.pop(key, [])
+        for sock in stack:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            for stack in self._idle.values():
+                for sock in stack:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            self._idle.clear()
+
+
+class RoundTripper:
+    """Pooled, token-stamped, watchdog-bracketed request/reply round trips
+    — the client half of the wire protocol, shared by ``ShardedStore``
+    (peer fetches + probes) and the fleet router (replica predicts +
+    probes).
+
+    Transient-fault policy (requests on this transport are idempotent, so
+    retrying is always safe): a stale POOLED socket (dropped by the
+    peer/NAT while parked) retries immediately on a fresh connection
+    without counting an attempt; a FRESH-connection failure retries per
+    the supplied ``RetryPolicy`` (exponential backoff + jitter, a warning
+    per retry). The last failure re-raises. A single-attempt policy pins
+    one try — failover paths do their own retrying ACROSS replicas, where
+    a per-replica backoff loop would multiply the outage by the replica
+    count.
+
+    ``guard(host, port, cell)`` arms the watchdog deadline (~1.25x the
+    socket timeout) around a round-trip: a peer that dribbles bytes
+    forever (resetting the per-``recv`` socket timeout every chunk) gets
+    its socket severed from the monitor thread, surfacing as the OSError
+    failover paths already handle. A severed pooled socket counts as a
+    SPENT deadline, never a stale socket to quietly retry."""
+
+    def __init__(self, timeout: float, auth_token: str | None = None,
+                 max_idle_per_peer: int = 4, watchdog_factor: float = 1.25):
+        self.pool = ConnPool(max_idle_per_peer, timeout=timeout)
+        self._auth_token = auth_token
+        self._watchdog = None  # lazy: built on first guarded round-trip
+        self._watchdog_factor = float(watchdog_factor)
+
+    @property
+    def timeout(self) -> float:
+        return self.pool.timeout
+
+    @timeout.setter
+    def timeout(self, value: float) -> None:
+        self.pool.timeout = float(value)
+        self._watchdog = None  # rebuilt with the new deadline on next guard
+
+    def request(self, key, host: str, port: int, *, policy,
+                _sock_cell: dict | None = None, **fields) -> bytes:
+        """One request/response round-trip on a pooled socket — no shared
+        lock held, so concurrent callers overlap their network waits. The
+        socket returns to the pool only after a clean round-trip; any
+        error closes it (a half-read stream cannot be reused).
+        ``_sock_cell`` (when given) exposes the in-flight socket so a
+        watchdog can sever a wedged round-trip from its monitor thread."""
+        from .retry import call_with_retries
+
+        if self._auth_token is not None:
+            fields["token"] = token_field(self._auth_token)
+        req = pack_arrays(fields)
+
+        def attempt_once() -> bytes:
+            while True:
+                sock, from_pool = self.pool.acquire(key, host, port)
+                if _sock_cell is not None:
+                    _sock_cell["sock"] = sock
+                try:
+                    send_msg(sock, req)
+                    payload = recv_msg(sock)
+                except BaseException as e:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    # a socket parked idle in the pool can be dropped by
+                    # the peer/NAT at any time; retry immediately on a
+                    # fresh connection without consuming an attempt — but
+                    # NEVER when the watchdog severed it: its one-shot
+                    # round-trip deadline is already spent, and a silent
+                    # fresh-connection retry would face the dribbling peer
+                    # unguarded (the unbounded hang the guard exists for)
+                    severed = _sock_cell is not None and _sock_cell.get("severed")
+                    if (
+                        from_pool
+                        and not severed
+                        and isinstance(e, (ConnectionError, OSError))
+                    ):
+                        continue
+                    raise
+                else:
+                    self.pool.release(key, sock)
+                    return payload
+
+        return call_with_retries(
+            attempt_once,
+            policy=policy,
+            retry_on=(ConnectionError, OSError),
+            describe=f"wire round-trip to {host}:{port}",
+            hint="HYDRAGNN_STORE_RETRIES tunes the cap",
+        )
+
+    def guard(self, host: str, port: int, cell: dict, what: str | None = None):
+        """Watchdog context for one round-trip: if it outlives
+        ``watchdog_factor`` x the socket timeout (the per-recv deadline
+        never fired — a dribbling peer), the monitor thread severs the
+        in-flight socket. Disabled for non-finite/zero timeouts."""
+        from contextlib import nullcontext
+
+        timeout = self.pool.timeout
+        if not (timeout and np.isfinite(timeout)):
+            return nullcontext()
+        if self._watchdog is None:
+            from ..resilience.watchdog import Watchdog
+
+            self._watchdog = Watchdog(timeout * self._watchdog_factor)
+
+        def sever() -> None:
+            # flag BEFORE closing: the blocked recv wakes the instant the
+            # socket dies, and the error path must already see "severed"
+            # (a severed pooled socket is a spent deadline, not a stale
+            # socket to quietly retry)
+            cell["severed"] = True
+            sock = cell.get("sock")
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        return self._watchdog.guard(
+            what or f"wire round-trip to {host}:{port}", on_expire=sever
+        )
+
+    def round_trip(self, key, host: str, port: int, *, policy,
+                   what: str | None = None, **fields) -> dict[str, np.ndarray]:
+        """Guarded request + decode in one call — the common client shape."""
+        cell: dict = {"sock": None}
+        with self.guard(host, port, cell, what=what):
+            return unpack_arrays(self.request(
+                key, host, port, policy=policy, _sock_cell=cell, **fields
+            ))
+
+    def evict(self, key) -> None:
+        self.pool.evict(key)
+
+    def close(self) -> None:
+        self.pool.close()
+
+
+# -- quarantine clock + replica ordering --------------------------------------
+
+
+class HealthTable:
+    """The PR 4 quarantine + doubling re-probe bookkeeping, factored so
+    ShardedStore peer failover and fleet replica failover share one clock.
+    An entry exists while the peer is suspect; each recorded failure
+    pushes the re-probe deadline out by the current backoff and doubles
+    the backoff up to the cap (``lift`` — a successful probe or fetch —
+    removes the entry). Keys are caller-defined (peer ranks, replica
+    ids)."""
+
+    def __init__(self, base_s: float, cap_s: float):
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.lock = threading.Lock()
+        # key -> {"until", "backoff", "failures"}; quarantined while
+        # now < until AND the entry exists
+        self.entries: dict = {}
+
+    def quarantined(self, key) -> bool:
+        with self.lock:
+            h = self.entries.get(key)
+            return h is not None and time.monotonic() < h["until"]
+
+    def bump(self, key) -> bool:
+        """Record one more failure for ``key`` — THE single implementation
+        of the quarantine clock, shared by fetch paths and probers (two
+        copies would silently diverge the first time the policy is
+        tuned). Returns True when this created the entry (a fresh
+        peer-down transition)."""
+        with self.lock:
+            h = self.entries.get(key)
+            fresh = h is None
+            if fresh:
+                h = self.entries[key] = {
+                    "until": 0.0, "backoff": self.base_s, "failures": 0,
+                }
+            h["failures"] += 1
+            h["until"] = time.monotonic() + h["backoff"]
+            h["backoff"] = min(h["backoff"] * 2.0, self.cap_s)
+        return fresh
+
+    def lift(self, key) -> dict | None:
+        """Remove ``key`` from the table (the peer answered); returns the
+        prior entry (failure count for the announcement) or None."""
+        with self.lock:
+            return self.entries.pop(key, None)
+
+    def order(self, keys, rot: int = 0) -> list:
+        """Failover order over a replica set: healthy peers first, rotated
+        by a per-client constant so different clients spread load across
+        replicas instead of all hammering the first-listed owner;
+        quarantined peers last (soonest-re-probe first) as a final resort
+        when nothing healthy is left."""
+        keys = list(keys)
+        healthy = [k for k in keys if not self.quarantined(k)]
+        with self.lock:
+            sick = sorted(
+                (k for k in keys if k not in healthy and k in self.entries),
+                key=lambda k: self.entries[k]["until"],
+            )
+        sick += [k for k in keys if k not in healthy and k not in sick]
+        if healthy:
+            r = rot % len(healthy)
+            healthy = healthy[r:] + healthy[:r]
+        return healthy + sick
+
+    def due_probes(self) -> list:
+        """Keys whose re-probe deadline has passed."""
+        now = time.monotonic()
+        with self.lock:
+            return [k for k, h in self.entries.items() if now >= h["until"]]
+
+
+__all__ = [
+    "HDR",
+    "MAGIC",
+    "ConnPool",
+    "HealthTable",
+    "RoundTripper",
+    "WireServer",
+    "check_pong",
+    "copy_sample",
+    "encode_samples",
+    "error_frame",
+    "field_text",
+    "frame_detail",
+    "pack_arrays",
+    "pong_frame",
+    "recv_exact",
+    "recv_msg",
+    "sample_fields",
+    "sample_from_arrays",
+    "sample_to_arrays",
+    "samples_from_frame",
+    "send_msg",
+    "text_field",
+    "token_field",
+    "token_ok",
+    "unpack_arrays",
+]

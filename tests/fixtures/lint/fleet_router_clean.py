@@ -1,0 +1,66 @@
+"""Sanctioned fleet-serving patterns (serve/fleet: router + replica host).
+
+The fleet tier is HOST code — threads, sockets, queues — wrapped around
+executables that were AOT-compiled at warm-up. Everything it does must
+stay GL-silent:
+
+- the dispatcher loop calls a PRE-COMPILED executable object per batch;
+  it never builds ``jax.jit`` inside the loop (GL003's target is jit-in-
+  loop, not dispatch-in-loop);
+- device results are materialized ONCE at the serving boundary
+  (``np.asarray`` on the executable's output before it goes on the wire)
+  — a host sync in plain host code, not reachable from inside any jitted
+  function (GL001 flags syncs INSIDE jit-reachable bodies);
+- queue/health bookkeeping branches on host Python values (deque lengths,
+  monotonic deadlines, in-flight counters) — never on traced values
+  (GL002);
+- wire frames decode to numpy via ``np.frombuffer`` views; nothing
+  touches a traced value on the socket path.
+"""
+import threading
+import time
+from collections import deque
+
+import jax
+import numpy as np
+
+
+def warm_executable(fn, example):
+    """Boot-time AOT compile — once, outside any serving loop."""
+    return jax.jit(fn).lower(example).compile()
+
+
+def dispatch_loop(queue: deque, executable, send, stop):
+    """The router/replica dispatcher shape: pop host-side work, run the
+    PRE-COMPILED executable, materialize at the boundary, put the bytes
+    on the wire. No jit in the loop, no traced branching."""
+    lock = threading.Lock()
+    inflight = 0
+    while not stop():
+        with lock:
+            if not queue:  # host-side queue state: a Python bool
+                pass
+        if not queue:
+            time.sleep(0.001)
+            continue
+        batch = queue.popleft()
+        if batch["deadline"] is not None and time.monotonic() >= batch["deadline"]:
+            continue  # deadline-aware shed: host clock vs host float
+        with lock:
+            inflight += 1
+        out = executable(batch["array"])
+        # the ONE materialization, at the serving boundary (host code;
+        # nothing jit-reachable calls this function)
+        payload = np.asarray(out).tobytes()
+        send(payload)
+        with lock:
+            inflight -= 1
+
+
+def least_loaded(replicas):
+    """Routing decision over host-side counters only."""
+    best = replicas[0]
+    for r in replicas[1:]:
+        if r["inflight"] < best["inflight"]:
+            best = r
+    return best

@@ -153,6 +153,16 @@ def test_pallas_kernel_wrappers_are_clean():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_fleet_router_thread_socket_code_is_clean():
+    """The fleet tier's shape (serve/fleet: dispatcher threads popping
+    host queues, watchdog/socket round-trips, pre-compiled executables
+    called per batch, ONE np.asarray materialization at the serving
+    boundary) is sanctioned host code: every rule must stay silent on it —
+    the router/replica must never acquire a jit-reachable host sync."""
+    findings = analyze([str(FIXTURES / "fleet_router_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_gl003_scan_folded_steps_are_clean():
     """lax.scan-folded supersteps (train/superstep.py's pattern: one jitted
     scan built outside the loop, dispatched per block) are the sanctioned

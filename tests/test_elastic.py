@@ -846,13 +846,22 @@ def test_store_and_sidecar_use_the_shared_policy(monkeypatch):
     from hydragnn_tpu.train import checkpoint
     from hydragnn_tpu.utils import retry
 
+    from hydragnn_tpu.utils import wire
+
     monkeypatch.setenv("HYDRAGNN_STORE_RETRIES", "7")
     assert retry.store_policy().attempts == 7
     src_store = inspect.getsource(sharded)
     src_ckpt = inspect.getsource(checkpoint)
-    assert "call_with_retries" in src_store
+    src_wire = inspect.getsource(wire)
+    # the store's round-trips run on the shared wire transport, whose
+    # retry loop IS call_with_retries; the store resolves the policy
+    # (store_policy / pinned attempts) and hands it down
+    assert "call_with_retries" in src_wire
+    assert "store_policy" in src_store
     assert "call_with_retries" in src_ckpt or "_read_json" in src_ckpt
-    assert "2 ** (attempt" not in src_store  # the PR 3 inline loop is gone
+    # the PR 3 inline loop is gone everywhere
+    assert "2 ** (attempt" not in src_store
+    assert "2 ** (attempt" not in src_wire
 
 
 # -- config / flags plumbing --------------------------------------------------

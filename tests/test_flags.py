@@ -76,6 +76,34 @@ def test_max_num_batch_flag_caps_epoch(monkeypatch):
     assert _max_num_batches(loader) == 2
 
 
+def test_fleet_flags_reach_fleet_config(monkeypatch):
+    """HYDRAGNN_FLEET_REPLICAS / HYDRAGNN_FLEET_CACHE_BYTES are typed,
+    registered, and land on FleetConfig (overriding the Serving.fleet
+    block, matching every other HYDRAGNN_* knob)."""
+    from hydragnn_tpu.serve.fleet import FleetConfig, fleet_config_defaults
+
+    monkeypatch.delenv("HYDRAGNN_FLEET_REPLICAS", raising=False)
+    monkeypatch.delenv("HYDRAGNN_FLEET_CACHE_BYTES", raising=False)
+    assert flags.get(flags.FLEET_REPLICAS) is None
+    assert flags.get(flags.FLEET_CACHE_BYTES) is None
+    base = FleetConfig.from_config(None)
+    assert base.replicas == fleet_config_defaults()["replicas"]
+
+    monkeypatch.setenv("HYDRAGNN_FLEET_REPLICAS", "5")
+    monkeypatch.setenv("HYDRAGNN_FLEET_CACHE_BYTES", "1024")
+    assert flags.get(flags.FLEET_REPLICAS) == 5
+    assert flags.get(flags.FLEET_CACHE_BYTES) == 1024
+    # env beats both the dataclass default AND an explicit config block
+    cfg = FleetConfig.from_config({"replicas": 3, "cache_bytes": 7})
+    assert cfg.replicas == 5
+    assert cfg.cache_bytes == 1024
+    # both flags are in the described registry (no typo-warn on use)
+    out = flags.describe()
+    assert "HYDRAGNN_FLEET_REPLICAS" in out
+    assert "HYDRAGNN_FLEET_CACHE_BYTES" in out
+    assert flags.warn_unknown() == []
+
+
 def test_affinity_pinning_smoke(monkeypatch):
     """AFFINITY pins collate workers (reference load_data.py:121-136) —
     smoke: a pinned worker thread ends up with a 1-core affinity mask."""

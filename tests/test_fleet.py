@@ -1,0 +1,548 @@
+"""Fleet serving tests (ISSUE 11): router + replicas + answer cache.
+
+Slow-mark budget, decided UP FRONT (the 870 s tier-1 cap has no slack):
+the module fixture warms ONE small GIN ``PredictionServer`` and every
+non-slow test reuses it behind fresh wire front ends — non-slow adds one
+warm-up plus seconds of wire traffic. Everything needing a SECOND model
+boot or real timing statistics rides the ``slow`` marker:
+
+* non-slow — the single-replica + answer-cache canary (bit parity with
+  the direct in-process server, cache hit bit-match), per-class shedding
+  order + deadline shed (deterministic via the replica delay knob), auth
+  rejection staying loud, dribbling-replica sever + failover (reuses the
+  one warm replica + a fake dribbler), traffic-generator determinism /
+  byte-compat, config/flags plumbing, answer-cache LRU unit tests;
+* slow — replica KILL mid-stream over two real warm servers (second
+  warm-up), the multi-PROCESS boot from checkpoint paths (subprocess
+  jax import + AOT warm-up), and the overload priority/p99 scenario.
+"""
+
+import copy
+import socket
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.datasets import deterministic_graph_data
+from hydragnn_tpu.preprocess.load_data import dataset_loading_and_splitting
+from hydragnn_tpu.models.create import create_model_config
+from hydragnn_tpu.serve import (
+    DeadlineExceededError,
+    FleetConfig,
+    FleetRouter,
+    PredictionServer,
+    QueueFullError,
+    ReplicaHost,
+    ServerClosedError,
+    ServingConfig,
+    UnknownModelError,
+    fleet_config_defaults,
+    mixed_priority_plan,
+    run_traffic,
+    zipf_duplicate_order,
+)
+from hydragnn_tpu.serve.fleet.cache import (
+    AnswerCache,
+    answer_key,
+    canonical_sample_bytes,
+)
+from hydragnn_tpu.train.optimizer import select_optimizer
+from hydragnn_tpu.train.step import create_train_state
+from hydragnn_tpu.utils import wire
+
+from test_config import CI_CONFIG
+
+
+@pytest.fixture(scope="module")
+def warm_server():
+    """ONE warm single-model PredictionServer shared by every non-slow
+    test (each wraps it in its own wire front ends); plus the ingredients
+    needed to boot siblings in the slow tests."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=40, seed=7)
+    tl, vl, sl = dataset_loading_and_splitting(copy.deepcopy(cfg), samples=samples)
+    aug = update_config(copy.deepcopy(cfg), tl.samples, vl.samples, sl.samples)
+    model = create_model_config(aug)
+    opt = select_optimizer(aug["NeuralNetwork"]["Training"]["Optimizer"])
+    state = create_train_state(
+        model, opt, jax.tree.map(jnp.asarray, next(iter(tl)))
+    )
+    server = PredictionServer(ServingConfig(flush_ms=2.0))
+    server.add_model("gin", model, state, aug, samples=samples, batch_size=8)
+    server.warmup(verify=True)
+    server.start()
+    yield {
+        "server": server, "samples": samples, "aug": aug,
+        "model": model, "state": state,
+    }
+    server.stop()
+
+
+def _heads(result):
+    return [np.asarray(a) for a in result["heads"]]
+
+
+def _router(*hosts, **cfg):
+    cfg.setdefault("peer_timeout", 5.0)
+    cfg.setdefault("cache_bytes", 1 << 22)
+    router = FleetRouter(cfg)
+    for h in hosts:
+        router.attach("127.0.0.1", h.port)
+    return router.start()
+
+
+# -- non-slow: the single-replica + cache canary ------------------------------
+
+
+def test_fleet_single_replica_cache_canary(warm_server):
+    """THE fast canary: a router over one wire replica serves answers
+    BIT-IDENTICAL to the direct in-process server; a duplicate graph is a
+    cache hit whose arrays bit-match the computed answer; routing errors
+    are typed."""
+    server, samples = warm_server["server"], warm_server["samples"]
+    host = ReplicaHost(server)
+    router = _router(host)
+    try:
+        probe = samples[:5]
+        direct = [_heads(server.submit("gin", s).result(timeout=30))
+                  for s in probe]
+        routed = [_heads(router.submit("gin", s).result(timeout=30))
+                  for s in probe]
+        for d, r in zip(direct, routed):
+            assert len(d) == len(r) >= 1
+            for a, b in zip(d, r):
+                assert np.array_equal(a, b)  # fp32/CPU: exact
+        # duplicate request: answered from the router's cache,
+        # byte-identical to the computed answer, zero replica compute
+        before = router.replica_stats(0)["served"]
+        hit = router.submit("gin", probe[0]).result(timeout=30)
+        assert hit["cached"] is True
+        for a, b in zip(routed[0], _heads(hit)):
+            assert np.array_equal(a, b)
+        assert router.replica_stats(0)["served"] == before
+        st = router.stats()
+        assert st["cache_hits"] == 1
+        assert st["cache"]["hits"] == 1 and st["cache"]["entries"] == 5
+        # the per-replica steady-lowering count is observable over the
+        # wire and ZERO (the AOT guarantee across the RPC boundary)
+        assert router.replica_stats(0)["steady_lowerings"] == 0
+        # typed routing errors
+        with pytest.raises(UnknownModelError):
+            router.submit("nope", probe[0])
+        with pytest.raises(ValueError, match="priority"):
+            router.submit("gin", probe[0], priority="vip")
+    finally:
+        router.stop()
+        host.close()
+    with pytest.raises(ServerClosedError):
+        router.submit("gin", samples[0])
+
+
+def test_cache_key_separates_content_model_and_quant(warm_server):
+    samples = warm_server["samples"]
+    a, b = samples[0], samples[1]
+    assert canonical_sample_bytes(a) == canonical_sample_bytes(a)
+    assert canonical_sample_bytes(a) != canonical_sample_bytes(b)
+    assert answer_key(a, "m1") == answer_key(a, "m1")
+    assert answer_key(a, "m1") != answer_key(a, "m2")
+    assert answer_key(a, "m1") != answer_key(a, "m1", quantized=True)
+    assert answer_key(a, "m1") != answer_key(b, "m1")
+
+
+def test_answer_cache_lru_byte_budget_and_isolation():
+    heads = lambda v: [np.full((4, 4), v, np.float32)]  # 64 bytes each
+    cache = AnswerCache(budget_bytes=3 * (64 + 2))
+    for key, v in (("k1", 1.0), ("k2", 2.0), ("k3", 3.0)):
+        assert cache.put(key, heads(v))
+    assert len(cache) == 3
+    # touch k1 so k2 is coldest, then insert k4: k2 evicts
+    assert cache.get("k1") is not None
+    assert cache.put("k4", heads(4.0))
+    assert cache.get("k2") is None
+    assert cache.get("k1") is not None and cache.get("k4") is not None
+    assert cache.stats()["evictions"] == 1
+    # byte accounting holds under eviction
+    assert cache.bytes <= cache.budget_bytes
+    # isolation: mutating a returned hit never corrupts later hits
+    got = cache.get("k3")
+    got[0][:] = -99.0
+    again = cache.get("k3")
+    assert np.array_equal(again[0], np.full((4, 4), 3.0, np.float32))
+    # oversize answers are skipped, not cached-by-evicting-everything
+    assert not cache.put("big", [np.zeros((64, 64), np.float32)])
+    assert cache.stats()["oversize_skips"] == 1
+    # budget 0 disables cleanly
+    off = AnswerCache(0)
+    assert not off.put("k", heads(1.0))
+    assert off.get("k") is None
+
+
+# -- non-slow: admission / shedding / failover --------------------------------
+
+
+def test_per_class_shedding_order_and_deadline_shed(warm_server):
+    """Deterministic overload: the replica's delay knob stalls dispatch so
+    the router queues back up. best-effort (budget 2) sheds FIRST with a
+    typed QueueFullError naming its class while interactive keeps
+    admitting; a deadline shorter than the stall sheds typed at dispatch
+    time. Queued work drains once the stall lifts — nothing is lost."""
+    server, samples = warm_server["server"], warm_server["samples"]
+    host = ReplicaHost(server)
+    router = _router(
+        host, budget_best_effort=2, budget_batch=4, budget_interactive=64,
+        inflight_per_replica=1, cache_bytes=0,
+    )
+    try:
+        host.set_delay(0.25)  # every replica answer now takes >= 0.25 s
+        futs = []
+        # distinct samples (cache off anyway) keep the replica busy
+        futs.append(router.submit("gin", samples[0], priority="batch"))
+        time.sleep(0.05)  # let it dispatch: the replica is now stalled
+        # fill best_effort to its budget of 2, third sheds
+        futs.append(router.submit("gin", samples[1], priority="best_effort"))
+        futs.append(router.submit("gin", samples[2], priority="best_effort"))
+        with pytest.raises(QueueFullError, match="best_effort"):
+            router.submit("gin", samples[3], priority="best_effort")
+        # the interactive class still admits (its own budget, not shared)
+        futs.append(router.submit("gin", samples[4], priority="interactive"))
+        # a deadline shorter than the stall sheds typed, never serves late
+        doomed = router.submit(
+            "gin", samples[5], priority="interactive", deadline_ms=40.0
+        )
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(timeout=10)
+        host.set_delay(0.0)
+        for f in futs:
+            assert f.result(timeout=30)["heads"]  # everything queued drains
+        st = router.stats()
+        assert st["shed_best_effort"] == 1
+        assert st["shed_deadline"] >= 1
+        assert st["shed"] >= 2
+    finally:
+        host.set_delay(0.0)
+        router.stop()
+        host.close()
+
+
+def test_auth_token_rejection_stays_loud(warm_server):
+    """An auth mismatch is a configuration bug: attach refuses LOUDLY
+    (typed RuntimeError naming the auth knob) instead of quarantining or
+    failing over; the matching token serves normally."""
+    server, samples = warm_server["server"], warm_server["samples"]
+    host = ReplicaHost(server, auth_token="s3cret")
+    try:
+        bad = FleetRouter({"peer_timeout": 5.0})  # no token configured
+        with pytest.raises(RuntimeError, match="auth token mismatch"):
+            bad.attach("127.0.0.1", host.port)
+        wrong = FleetRouter({"peer_timeout": 5.0, "auth": "nope"})
+        with pytest.raises(RuntimeError, match="auth token mismatch"):
+            wrong.attach("127.0.0.1", host.port)
+        good = FleetRouter({"peer_timeout": 5.0, "auth": "s3cret"})
+        good.attach("127.0.0.1", host.port)
+        good.start()
+        try:
+            assert good.predict("gin", samples[:2])
+        finally:
+            good.stop()
+    finally:
+        host.close()
+
+
+class _Dribbler:
+    """A fake replica that answers ping/stats like a ready twin of the
+    real endpoint but DRIBBLES predict responses one byte per tick — the
+    per-recv socket timeout never fires, only the watchdog's whole-round-
+    trip deadline can catch it (the elastic plane's nastiest gray
+    failure, now on the serving wire)."""
+
+    def __init__(self, models=("gin",)):
+        self._models = ",".join(models)
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                z = wire.unpack_arrays(wire.recv_msg(conn))
+                if "ping" in z:
+                    wire.send_msg(conn, wire.pong_frame(
+                        ready=np.asarray(1, np.int64),
+                        models=wire.text_field(self._models),
+                        quantized=np.zeros(1, np.int64),
+                    ))
+                    continue
+                # dribble: claim a 1 MiB response, deliver a byte per tick
+                for b in wire.HDR.pack(1 << 20):
+                    time.sleep(0.1)
+                    conn.sendall(bytes([b]))
+        except (OSError, ValueError, ConnectionError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._srv.close()
+
+
+def test_dribbling_replica_severed_and_failed_over(warm_server):
+    """A replica that dribbles bytes is severed by the watchdog (~1.25x
+    peer_timeout), quarantined, and its requests fail over to the healthy
+    sibling — every future resolves, bounded, zero lost."""
+    server, samples = warm_server["server"], warm_server["samples"]
+    real = ReplicaHost(server)
+    drib = _Dribbler()
+    router = FleetRouter({"peer_timeout": 0.4, "cache_bytes": 0,
+                          "quarantine_base_s": 30.0})
+    try:
+        router.attach("127.0.0.1", drib.port)
+        router.attach("127.0.0.1", real.port)
+        router.start()
+        t0 = time.monotonic()
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            futs = [router.submit("gin", samples[i], priority="batch")
+                    for i in range(6)]
+            got = [f.result(timeout=30)["heads"] for f in futs]
+        elapsed = time.monotonic() - t0
+        assert len(got) == 6  # zero lost requests
+        assert elapsed < 15.0, f"dribbler stalled the fleet for {elapsed:.1f}s"
+        st = router.stats()
+        assert st["failovers"] >= 1 and st["requeues"] >= 1
+        assert st["replicas"][0]["quarantined"]  # the dribbler is severed
+        assert not st["replicas"][1]["quarantined"]
+        assert any("watchdog" in str(w.message) for w in rec)
+    finally:
+        router.stop()
+        drib.close()
+        real.close()
+
+
+# -- non-slow: traffic generators / config ------------------------------------
+
+
+def test_traffic_generators_seeded_and_byte_compatible():
+    # the pre-fleet uniform draw is unchanged: same seed, same stream
+    legacy = np.random.default_rng(3).integers(0, 17, size=50)
+    again = np.random.default_rng(3).integers(0, 17, size=50)
+    np.testing.assert_array_equal(legacy, again)
+    # zipf: deterministic per seed, bounded, heavy-headed
+    z1 = zipf_duplicate_order(400, 32, alpha=1.2, seed=9)
+    z2 = zipf_duplicate_order(400, 32, alpha=1.2, seed=9)
+    np.testing.assert_array_equal(z1, z2)
+    assert z1.min() >= 0 and z1.max() < 32
+    counts = np.bincount(z1, minlength=32)
+    assert counts[0] > counts[16] >= counts[31] or counts[0] > counts[31]
+    assert (z1 != zipf_duplicate_order(400, 32, alpha=1.2, seed=10)).any()
+    # mixed-priority plan: deterministic, normalized, only known classes
+    p1 = mixed_priority_plan(200, seed=4)
+    assert p1 == mixed_priority_plan(200, seed=4)
+    assert set(p1) <= {"interactive", "batch", "best_effort"}
+    assert p1.count("batch") > p1.count("interactive")
+    with pytest.raises(ValueError):
+        mixed_priority_plan(10, mix={"interactive": -1.0})
+    with pytest.raises(ValueError):
+        zipf_duplicate_order(10, 0)
+
+
+def test_run_traffic_priorities_reach_router_and_tag_report(warm_server):
+    server, samples = warm_server["server"], warm_server["samples"]
+    host = ReplicaHost(server)
+    router = _router(host, cache_bytes=0)
+    try:
+        pri = mixed_priority_plan(12, seed=0)
+        rep = run_traffic(router, "gin", samples[:8], 12,
+                          priorities=pri, seed=1)
+        assert rep.n_served == 12
+        assert set(rep.latencies_by_tag) == set(pri)
+        assert sum(len(v) for v in rep.latencies_by_tag.values()) == 12
+        assert rep.summary()[f"p99_ms_{pri[0]}"] is not None
+    finally:
+        router.stop()
+        host.close()
+
+
+def test_fleet_config_block_schema_and_flags(monkeypatch):
+    samples = deterministic_graph_data(number_configurations=6, seed=3)
+    aug = update_config(copy.deepcopy(CI_CONFIG), samples)
+    assert aug["Serving"]["fleet"] == fleet_config_defaults()
+    # partial nested block keeps caller keys, fills the rest
+    part = copy.deepcopy(CI_CONFIG)
+    part["Serving"] = {"fleet": {"replicas": 4, "cache_bytes": 123}}
+    aug2 = update_config(part, samples)
+    assert aug2["Serving"]["fleet"]["replicas"] == 4
+    assert aug2["Serving"]["fleet"]["cache_bytes"] == 123
+    assert (
+        aug2["Serving"]["fleet"]["budget_interactive"]
+        == fleet_config_defaults()["budget_interactive"]
+    )
+    # typo'd nested keys and bad values fail at config load, loudly
+    bad = copy.deepcopy(CI_CONFIG)
+    bad["Serving"] = {"fleet": {"replicaz": 2}}
+    with pytest.raises(ValueError, match="replicaz"):
+        update_config(bad, samples)
+    bad = copy.deepcopy(CI_CONFIG)
+    bad["Serving"] = {"fleet": {"replicas": 0}}
+    with pytest.raises(ValueError, match="replicas"):
+        update_config(bad, samples)
+    bad = copy.deepcopy(CI_CONFIG)
+    bad["Serving"] = {"fleet": []}
+    with pytest.raises(ValueError, match="fleet"):
+        update_config(bad, samples)
+    # FleetConfig.from_config accepts the filled full config; env wins
+    cfg = FleetConfig.from_config(aug2)
+    assert cfg.replicas == 4 and cfg.cache_bytes == 123
+    monkeypatch.setenv("HYDRAGNN_FLEET_REPLICAS", "7")
+    monkeypatch.setenv("HYDRAGNN_FLEET_CACHE_BYTES", "999")
+    cfg = FleetConfig.from_config(aug2)
+    assert cfg.replicas == 7 and cfg.cache_bytes == 999
+
+
+# -- slow: second boot / multi-process / timing statistics --------------------
+
+
+@pytest.mark.slow
+def test_replica_kill_mid_stream_zero_lost(warm_server):
+    """Two real warm servers behind the router; one dies mid-stream (its
+    wire host severed LIKE a host loss) — every in-flight and queued
+    request still resolves with an answer from the survivor."""
+    samples, aug = warm_server["samples"], warm_server["aug"]
+    model, state = warm_server["model"], warm_server["state"]
+    second = PredictionServer(ServingConfig(flush_ms=2.0))
+    second.add_model("gin", model, state, aug, samples=samples, batch_size=8)
+    second.warmup(verify=True)
+    second.start()
+    h1 = ReplicaHost(warm_server["server"])
+    h2 = ReplicaHost(second)
+    router = _router(h1, h2, cache_bytes=0)
+    try:
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            futs = [router.submit("gin", samples[i % 24], priority="batch")
+                    for i in range(24)]
+            h1.close()  # dead host: established conns severed, no teardown
+            got = [f.result(timeout=60)["heads"] for f in futs]
+        assert len(got) == 24  # zero lost requests
+        st = router.stats()
+        assert st["served"] == 24 and st["failed"] == 0
+        # the survivor carried the failed-over share
+        assert st["replicas"][1]["served"] >= 12
+    finally:
+        router.stop()
+        h2.close()
+        h1.close()
+        second.stop()
+
+
+@pytest.mark.slow
+def test_overload_interactive_rides_ahead_of_best_effort(warm_server):
+    """Under overload (replica stalled per answer), strict-priority
+    dispatch serves every interactive probe while deadline-laden
+    best-effort backlog sheds — per-class shedding order under load."""
+    server, samples = warm_server["server"], warm_server["samples"]
+    host = ReplicaHost(server)
+    router = _router(
+        host, cache_bytes=0, inflight_per_replica=1,
+        budget_best_effort=64, budget_interactive=64,
+    )
+    try:
+        host.set_delay(0.08)
+        flood = [
+            router.submit("gin", samples[i % 16], priority="best_effort",
+                          deadline_ms=400.0)
+            for i in range(24)
+        ]
+        probes = [
+            router.submit("gin", samples[i % 4], priority="interactive")
+            for i in range(6)
+        ]
+        served_probes = [f.result(timeout=60)["heads"] for f in probes]
+        assert len(served_probes) == 6  # interactive never shed
+        outcomes = {"served": 0, "deadline": 0}
+        for f in flood:
+            try:
+                f.result(timeout=60)
+                outcomes["served"] += 1
+            except DeadlineExceededError:
+                outcomes["deadline"] += 1
+        # the backlog cannot fit 24 x 80 ms inside 400 ms: the tail sheds
+        assert outcomes["deadline"] > 0
+        assert router.stats()["shed_deadline"] == outcomes["deadline"]
+    finally:
+        host.set_delay(0.0)
+        router.stop()
+        host.close()
+
+
+@pytest.mark.slow
+def test_subprocess_replica_boots_from_checkpoint_and_serves(
+    warm_server, tmp_path
+):
+    """The multi-process path: a worker SUBPROCESS boots a PredictionServer
+    from checkpoint paths alone (config.json + checkpoint + samples file),
+    finishes AOT warm-up BEFORE advertising ready, and serves through the
+    router bit-identically to the in-process server."""
+    from hydragnn_tpu.config.schema import save_config
+    from hydragnn_tpu.serve.fleet.replica import (
+        spawn_replica,
+        write_samples_file,
+    )
+    from hydragnn_tpu.train.checkpoint import save_checkpoint
+
+    server, samples = warm_server["server"], warm_server["samples"]
+    aug, state = warm_server["aug"], warm_server["state"]
+    logs = str(tmp_path / "logs")
+    save_config(aug, "fleet_ckpt", path=logs)
+    save_checkpoint(state, "fleet_ckpt", epoch=0, path=logs)
+    samples_file = write_samples_file(
+        samples, str(tmp_path / "bucket_samples.wire")
+    )
+    spec = {
+        "models": [{
+            "name": "gin", "log_name": "fleet_ckpt", "path": logs,
+            "samples_file": samples_file, "batch_size": 8,
+        }],
+        "serving": {"flush_ms": 2.0},
+    }
+    worker = spawn_replica(spec, timeout_s=420.0,
+                           env={"JAX_PLATFORMS": "cpu"})
+    router = FleetRouter({"peer_timeout": 30.0, "cache_bytes": 0})
+    try:
+        router.attach("127.0.0.1", worker.port)
+        router.start()
+        probe = samples[:4]
+        direct = [_heads(server.submit("gin", s).result(timeout=30))
+                  for s in probe]
+        routed = [_heads(router.submit("gin", s).result(timeout=60))
+                  for s in probe]
+        for d, r in zip(direct, routed):
+            for a, b in zip(d, r):
+                assert np.array_equal(a, b)  # across the process boundary
+        # ready meant warm: the subprocess replica served with zero
+        # steady-state lowerings
+        assert router.replica_stats(0)["steady_lowerings"] == 0
+    finally:
+        router.stop()
+        worker.terminate()
