@@ -1152,44 +1152,20 @@ def bench_fleet_overload_ab(n_flood: int = 48, n_probes: int = 24,
 
 
 def _iqr(xs):
-    s = sorted(xs)
-    if len(s) < 4:  # too few windows for quartiles: full range (>= 0)
-        return s[-1] - s[0]
-    q = len(s) // 4
-    return s[-1 - q] - s[q]
+    from hydragnn_tpu.utils.abtest import iqr
+
+    return iqr(xs)
 
 
 def _abba_verdict(a_ms, b_ms, budget_pct: float):
-    """PR 3's paired-window noise-floor verdict, factored out so every ABBA
-    A/B row (guard overhead, failover recovery) issues verdicts the same
-    way. ``(overhead_pct, noise_pct, verdict)`` where overhead is the
-    median of PAIRED per-window differences over the A-arm median, and the
-    noise floor is the WORST of the pair-difference IQR and each arm's own
-    window IQR — repeated runs on this 2-vCPU box showed the pair spread
-    alone underestimates run-to-run noise (pairs can agree with each other
-    while both arms drift) and issues hard verdicts from scheduler luck.
-    ``pass``/``fail`` only when the measurement resolves the budget; else
-    ``inconclusive`` records the numbers without laundering noise into a
-    verdict."""
-    med_a = statistics.median(a_ms)
-    diffs = [b - a for a, b in zip(a_ms, b_ms)]
-    overhead_pct = 100.0 * statistics.median(diffs) / med_a
-    noise_pct = 100.0 * max(_iqr(diffs), _iqr(a_ms), _iqr(b_ms)) / med_a
-    if overhead_pct + noise_pct < budget_pct:
-        verdict = "pass"  # under budget even pessimistically
-    elif overhead_pct - noise_pct > budget_pct:
-        verdict = "fail"  # over budget even optimistically
-    elif noise_pct <= budget_pct / 2:
-        # the floor is well under the budget: the threshold itself resolves
-        verdict = "pass" if overhead_pct < budget_pct else "fail"
-    else:
-        verdict = "inconclusive"  # host too noisy to resolve the budget
-    if len(diffs) < 4 and noise_pct > budget_pct / 2:
-        # under 4 pairs the range-based floor underestimates the true
-        # spread — a stall hitting both windows of one arm can fabricate a
-        # confident verdict; only a near-zero floor earns one
-        verdict = "inconclusive"
-    return overhead_pct, noise_pct, verdict
+    """PR 3's paired-window noise-floor verdict — now living in
+    ``hydragnn_tpu.utils.abtest`` so the kernel-geometry autotuner
+    (``ops/autotune.py``) issues verdicts with the EXACT same discipline as
+    every bench A/B row. Imported lazily (bench's parent process must run
+    without the package/jax importable)."""
+    from hydragnn_tpu.utils.abtest import abba_verdict
+
+    return abba_verdict(a_ms, b_ms, budget_pct)
 
 
 def bench_resilience_overhead(batch_size: int = 64, bench_steps: int = 30,
@@ -1889,6 +1865,11 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
     # 4 windows even in the smoke: _abba_verdict refuses a hard verdict
     # under 4 pairs, and the overload row's p99 claim deserves one
     fleet_overload = _row(bench_fleet_overload_ab, 32, 16, 4)
+    # ISSUE 12 rows: both CPU-provable by construction (the bf16 row's
+    # verdict is honest about emulation; the autotune row proves the sweep/
+    # cache/ABBA mechanism end to end on this backend)
+    bf16_ab = _row(bench_bf16_train_ab, min(batch_size, 64), 16, 2)
+    autotune_ab = _row(bench_autotune_ab, 48)
     return {
         "workload": "cpu_smoke",
         "degraded": True,
@@ -1905,6 +1886,8 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
         "quant_serving_ab": quant,
         "fleet_serving_ab": fleet,
         "fleet_overload_ab": fleet_overload,
+        "bf16_train_ab": bf16_ab,
+        "autotune_ab": autotune_ab,
     }
 
 
@@ -2084,19 +2067,23 @@ def _stage_gs_batch(n_samples: int, batch_size: int, c: int, seed: int,
     return b, n, h, snd, rcv, w
 
 
-def bench_fused_autotune(batch_size: int = 128, reps: int = 30) -> dict:
-    """(window, block_edges) autotune sweep for the fused gather-scatter
-    kernel on a production-bucket batch (VERDICT r4 item 1): each geometry
-    host-certified via ``window_fits_host`` before timing, vs the XLA
-    gather+segment_sum reference on the same batch, in BOTH compute dtypes
-    (bf16 = the production conv-stack path, fp32 = the MLIP path; the MXU
-    precision mode differs, so the optimum can too). On CPU this runs in
-    interpret mode — only a TPU window's numbers are tuning data."""
+def bench_fused_autotune(batch_size: int = 128, reps: int = 10) -> dict:
+    """(window, block_edges) sweep for the fused gather-scatter kernel on a
+    production-bucket batch (VERDICT r4 item 1) — since PR 12 routed through
+    the SHARED autotuner (``ops/autotune.py``): the same candidate grid,
+    host-certified through the same ``window_fits_host`` filters, but timed
+    with the ABBA paired-window discipline and PERSISTED per (kernel, shape,
+    backend) so the choice actually feeds back into ``ops/`` instead of
+    dying in this row's JSON. Swept in BOTH compute dtypes (bf16 = the
+    production conv-stack path, fp32 = the MLIP path; the MXU precision mode
+    differs, so the optimum can too). On CPU only the certification table is
+    produced — interpret-mode timings are not tuning data (the autotuner
+    MECHANISM is the ``autotune_ab`` row's job)."""
     import jax
     import jax.numpy as jnp
 
+    from hydragnn_tpu.ops import autotune as at
     from hydragnn_tpu.ops.fused_scatter import (
-        fused_gather_scatter,
         reference_gather_scatter,
         window_fits_host,
     )
@@ -2108,70 +2095,308 @@ def bench_fused_autotune(batch_size: int = 128, reps: int = 30) -> dict:
     snd_np, rcv_np = np.asarray(b.senders), np.asarray(b.receivers)
     inputs = {"bf16": h32.astype(jnp.bfloat16), "fp32": h32}
 
-    def time_call(fn, h):
-        out = fn(h, snd, rcv, w)  # compile
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = fn(h, snd, rcv, w)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / reps * 1e3
-
     rec: dict = {
         "workload": "fused_autotune",
         "backend": jax.default_backend(),
         "n_node": n, "n_edge": int(snd.shape[0]), "channels": c,
         "batch_size": batch_size,
+        "cache_file": at.cache_path(),
     }
     on_tpu = jax.default_backend() == "tpu"
-    ref_ms = {}
-    if on_tpu:
-        for dt, h in inputs.items():
-            ref_ms[dt] = time_call(
-                jax.jit(lambda h, s, r, w: reference_gather_scatter(h, s, r, n, w)),
-                h,
-            )
-        rec["xla_reference_ms"] = {k: round(v, 4) for k, v in ref_ms.items()}
+    # certification table through the shared filters (every backend): the
+    # static-fit column is the autotuner's own candidate filter
+    static_ok = set(at.gs_static_candidates(n, c))
     geoms = []
-    for window, block_edges in ((128, 128), (256, 256), (256, 512), (512, 256)):
+    for window, block_edges in at.GS_CANDIDATES:
         fits = (
             window_fits_host(snd_np, n, window, block_edges, exempt_pad_id=True)
             and window_fits_host(rcv_np, n, window, block_edges,
                                  exempt_pad_id=True)
         )
-        entry = {"window": window, "block_edges": block_edges,
-                 "certified": bool(fits)}
-        if not on_tpu:
-            # interpret-mode timings are meaningless; record certification
-            # only so CPU smoke runs stay fast
-            entry["skipped_timing"] = "non-tpu backend"
-        elif fits and n >= window:
-            for dt, h in inputs.items():
-                # cert_geometry keeps the host certificate at this geometry,
-                # so the timing is the static kernel-only path (no cond)
-                ms = time_call(
-                    jax.jit(
-                        lambda h, s, r, w, _win=window, _be=block_edges:
-                        fused_gather_scatter(h, s, r, n, w, window=_win,
-                                             block_edges=_be, fits=True,
-                                             cert_geometry=(_win, _be))
-                    ),
-                    h,
-                )
-                entry[f"ms_{dt}"] = round(ms, 4)
-                entry[f"speedup_vs_xla_{dt}"] = round(ref_ms[dt] / ms, 4)
-        geoms.append(entry)
+        geoms.append({
+            "window": window, "block_edges": block_edges,
+            "certified": bool(fits),
+            "static_ok": (window, block_edges) in static_ok,
+            "cert_transfers_to_wrapper": at.gs_cert_compatible(
+                window, block_edges, n
+            ),
+        })
     rec["geometries"] = geoms
-    for dt in inputs:
-        timed = [g for g in geoms if f"ms_{dt}" in g]
-        if timed:
-            best = min(timed, key=lambda g: g[f"ms_{dt}"])
-            rec[f"best_{dt}"] = {
-                "window": best["window"], "block_edges": best["block_edges"],
-                "ms": best[f"ms_{dt}"],
-                "speedup_vs_xla": best[f"speedup_vs_xla_{dt}"],
-            }
+    if not on_tpu:
+        rec["skipped_timing"] = (
+            "non-tpu backend: interpret-mode sweep timings are not tuning "
+            "data; see autotune_ab for the CPU-provable mechanism"
+        )
+        return rec
+
+    def time_ref(h):
+        fn = jax.jit(lambda h, s, r, w: reference_gather_scatter(h, s, r, n, w))
+        return at._time_window(fn, (h, snd, rcv, w), reps)
+
+    for dt, h in inputs.items():
+        sweep = at.autotune_gather_scatter(
+            h, snd, rcv, n, w, reps=reps, pairs=4, force=True
+        )
+        rec[f"sweep_{dt}"] = {
+            "chosen": sweep["geometry"],
+            "trials": sweep.get("evidence", {}).get("trials", {}),
+            "sweep_s": sweep.get("sweep_s"),
+            "xla_reference_ms": round(time_ref(h), 4),
+        }
     return rec
+
+
+def bench_autotune_ab(batch_size: int = 96, reps: int = 2,
+                      pairs: int = 4) -> dict:
+    """PR 12 acceptance row — the shared kernel-geometry autotuner
+    (``ops/autotune.py``), CPU-provable end to end:
+
+    * COLD sweep on a real collated batch: candidates filtered by the
+      fused-scatter static + certificate rules, ABBA paired-window timed
+      against the incumbent, per-(kernel, shape, backend) choice persisted
+      next to the XLA compile cache;
+    * WARM cache: the same call again returns the cached choice with ZERO
+      sweep cost (``sweeps_run`` unchanged, ``sweep_s == 0``);
+    * chosen-vs-default ABBA at budget 0: the cached choice must be at
+      least as fast as the hard-coded default — when the sweep kept the
+      default the two arms are the SAME program by construction and the
+      verdict is 'pass' with zero timing risk;
+    * per-geometry TPU lowered-op counts (``jax.export``) + analytic MXU
+      one-hot FLOPs — the evidence currency when this host's wall clock
+      can't resolve interpret-mode deltas;
+    * second kernel axis (quant_matmul row block) swept through the SAME
+      machinery, plus the cert-pinned kernels (softmax, cell list) showing
+      their candidate filters collapse to the documented singleton."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops import autotune as at
+    from hydragnn_tpu.ops.fused_scatter import fused_gather_scatter
+    from hydragnn_tpu.ops.quant_matmul import quant_dense, quantize_weight
+
+    c = 16  # narrow channels keep interpret-mode windows fast on CPU
+    b, n, h, snd, rcv, w = _stage_gs_batch(
+        max(batch_size * 2, 192), batch_size, c, seed=41
+    )
+    rec: dict = {
+        "workload": "autotune_ab",
+        "backend": jax.default_backend(),
+        "n_node": n, "n_edge": int(snd.shape[0]), "channels": c,
+        "cache_file": at.cache_path(),
+    }
+    t0 = time.perf_counter()
+    cold = at.autotune_gather_scatter(h, snd, rcv, n, w, reps=reps,
+                                      pairs=pairs, force=True)
+    rec["cold_sweep"] = {
+        "chosen": cold["geometry"],
+        "sweep_s": cold.get("sweep_s"),
+        "trials": cold.get("evidence", {}).get("trials", {}),
+        "candidates": cold.get("evidence", {}).get("candidates", []),
+    }
+    sweeps_before = at.sweeps_run()
+    t1 = time.perf_counter()
+    warm = at.autotune_gather_scatter(h, snd, rcv, n, w)
+    rec["warm_cache"] = {
+        "hit": warm.get("cache") == "hit",
+        "lookup_s": round(time.perf_counter() - t1, 6),
+        "swept": warm.get("swept"),
+        "zero_sweep_cost": (
+            at.sweeps_run() == sweeps_before
+            and warm.get("cache") == "hit"
+            and warm.get("sweep_s") == 0.0
+        ),
+    }
+    from hydragnn_tpu.ops.fused_scatter import GS_CERT_BLOCK, GS_CERT_WINDOW
+
+    chosen = tuple(cold["geometry"])
+    default = (GS_CERT_WINDOW, GS_CERT_BLOCK)
+    rec["chosen"] = list(chosen)
+    rec["default"] = list(default)
+
+    def build(geom):
+        window, block_edges = geom
+        fn = jax.jit(
+            lambda h_, s_, r_, w_, _win=window, _be=block_edges:
+            fused_gather_scatter(h_, s_, r_, n, w_, window=_win,
+                                 block_edges=_be, fits=True,
+                                 cert_geometry=(_win, _be))
+        )
+        return fn, (h, snd, rcv, w)
+
+    if chosen == default:
+        rec.update({
+            "chosen_overhead_pct": 0.0, "noise_pct": 0.0,
+            "abba_verdict": "pass",
+            "note": "sweep kept the default: both arms are the same "
+                    "program by construction",
+        })
+    else:
+        # the autotuner's own interleave (ONE timing discipline — this row
+        # validates the exact loop production sweeps run)
+        a_ms, b_ms = at._abba_pairs(
+            lambda: build(default), lambda: build(chosen), reps, pairs
+        )
+        overhead_pct, noise_pct, verdict = _abba_verdict(a_ms, b_ms,
+                                                         budget_pct=0.0)
+        rec.update({
+            "default_ms_windows": [round(x, 3) for x in a_ms],
+            "chosen_ms_windows": [round(x, 3) for x in b_ms],
+            # negative = the cached choice is faster than the default
+            "chosen_overhead_pct": round(overhead_pct, 2),
+            "noise_pct": round(noise_pct, 2),
+            "abba_verdict": verdict,
+        })
+    # evidence columns for an inconclusive wall clock: lowered-op counts on
+    # the real Mosaic pipeline + analytic per-edge one-hot MXU FLOPs (the
+    # gather and scatter dots are [BE, W] x [W, C]: 4·window·C FLOPs/edge —
+    # geometry changes FLOPs/VMEM, not HBM bytes, for this kernel)
+    for label, geom in (("default", default), ("chosen", chosen)):
+        wdw, be = geom
+        rec[f"tpu_lowering_{label}"] = _tpu_lowering_stats(
+            lambda h_, s_, r_, w_, _w=wdw, _b=be: fused_gather_scatter(
+                h_, s_, r_, n, w_, window=_w, block_edges=_b, fits=True,
+                cert_geometry=(_w, _b), interpret=False), h, snd, rcv, w,
+        )
+        rec[f"mxu_flops_per_edge_{label}"] = 4 * wdw * c
+    # second axis through the same machinery: quant row block
+    rng = np.random.default_rng(7)
+    qx = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    qw = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    qb = jnp.asarray(rng.normal(size=(16,)), jnp.float32)
+    w_q, s_w = quantize_weight(qw)
+    qrec = at.autotune_quant_dense(qx, w_q, s_w, 0.02, qb, reps=reps,
+                                   pairs=pairs, force=True)
+    qref = quant_dense(qx, w_q, s_w, 0.02, qb, kernel=True, interpret=None,
+                       row_block=8)
+    qtuned = quant_dense(qx, w_q, s_w, 0.02, qb, kernel=True, interpret=None,
+                         row_block=int(qrec["geometry"]))
+    rec["quant_matmul_sweep"] = {
+        "chosen_row_block": qrec["geometry"],
+        "trials": qrec.get("evidence", {}).get("trials", {}),
+        "tuned_bit_identical_to_default": bool(
+            np.array_equal(np.asarray(qref), np.asarray(qtuned))
+        ),
+    }
+    # cert-pinned kernels: the filters collapse to the documented singleton
+    sm = at.autotune_softmax(n, 8)
+    rec["softmax_pinned"] = {
+        "geometry": sm["geometry"],
+        "pinned_by": sm.get("evidence", {}).get("pinned_by"),
+    }
+    rec["cell_list_candidates_4096"] = at.cl_static_candidates(4096, 512, 24)
+    rec["total_s"] = round(time.perf_counter() - t0, 2)
+    return rec
+
+
+def bench_bf16_train_ab(batch_size: int = 64, bench_steps: int = 24,
+                        warmup: int = 2, windows: int = 4) -> dict:
+    """PR 12 — the bf16 fast-path A/B: the SAME flagship train step built at
+    fp32 vs bf16 compute (fp32 master weights and fp32 gradients/optimizer
+    both ways — the arms differ ONLY in the per-step cast-to-compute), in
+    ABBA paired windows with per-arm compile-sentinel lowering counts and
+    the analytic cast-traffic delta. On this CPU host bf16 is EMULATED
+    (cast + fp32 math + cast back), so wall clock regularly goes the WRONG
+    way — the verdict is recorded honestly; the halved compute-copy bytes
+    and the unchanged program count are the TPU-facing evidence, and the
+    real MXU win stays unmeasurable until a bench window gets a live
+    backend (ROADMAP standing constraint)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.analysis.sentinel import compile_counts
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train import (
+        create_train_state,
+        make_train_step,
+        select_optimizer,
+    )
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 64
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    samples = make_qm9_like_samples(max(batch_size * 2, 256), seed=43)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    batches = [jax.tree.map(jnp.asarray, b)
+               for b in GraphLoader(samples, batch_size, shuffle=True)]
+    step32 = make_train_step(model, optimizer, compute_dtype=jnp.float32)
+    step16 = make_train_step(model, optimizer, compute_dtype=jnp.bfloat16)
+    state32 = create_train_state(model, optimizer, batches[0])
+    state16 = create_train_state(model, optimizer, batches[0])
+
+    # per-arm compile cost, bracketed around each arm's first (compiling)
+    # step via the sentinel's lowering counters
+    c0 = compile_counts()["lowerings"]
+    state32, _ = _time_steps(step32, state32, batches, warmup)
+    lower32 = compile_counts()["lowerings"] - c0
+    c1 = compile_counts()["lowerings"]
+    state16, _ = _time_steps(step16, state16, batches, warmup)
+    lower16 = compile_counts()["lowerings"] - c1
+
+    n = max(bench_steps // max(windows, 1), 8)
+    # untimed burn-in pair (post-compile allocator/cache settle)
+    state32, _ = _time_steps(step32, state32, batches, n)
+    state16, _ = _time_steps(step16, state16, batches, n)
+    a_ms, b_ms = [], []
+    for wi in range(max(windows, 1)):
+        if wi % 2 == 0:
+            state32, t32 = _time_steps(step32, state32, batches, n)
+            state16, t16 = _time_steps(step16, state16, batches, n)
+        else:
+            state16, t16 = _time_steps(step16, state16, batches, n)
+            state32, t32 = _time_steps(step32, state32, batches, n)
+        a_ms.append(1e3 * t32 / n)
+        b_ms.append(1e3 * t16 / n)
+    overhead_pct, noise_pct, verdict = _abba_verdict(a_ms, b_ms,
+                                                     budget_pct=0.0)
+    # analytic cast-traffic delta per step: every float param + batch leaf
+    # is cast to the compute dtype (the fp32 master stays resident), so the
+    # compute copies halve at bf16 — exactly computable from the pytrees
+    param_elems = sum(
+        int(np.prod(np.shape(x))) for x in jax.tree.leaves(state32.params)
+        if np.issubdtype(np.asarray(x).dtype, np.floating)
+    )
+    batch_elems = sum(
+        int(np.prod(np.shape(x))) for x in jax.tree.leaves(batches[0])
+        if hasattr(x, "dtype") and np.issubdtype(np.asarray(x).dtype,
+                                                 np.floating)
+    )
+    # params fp32 both arms; bf16 state dtypes asserted fp32 (master-weight
+    # invariant — the same gate the tier-1 tests pin)
+    master_fp32 = all(
+        np.asarray(x).dtype == np.float32
+        for x in jax.tree.leaves(state16.params)
+        if np.issubdtype(np.asarray(x).dtype, np.floating)
+    )
+    return {
+        "workload": "bf16_train_ab",
+        "backend": jax.default_backend(),
+        "batch_size": batch_size,
+        "step_ms_fp32": round(statistics.median(a_ms), 3),
+        "step_ms_bf16": round(statistics.median(b_ms), 3),
+        "window_ms_fp32": [round(x, 2) for x in a_ms],
+        "window_ms_bf16": [round(x, 2) for x in b_ms],
+        # negative = bf16 faster; on CPU (emulated bf16) expect >= 0
+        "bf16_overhead_pct": round(overhead_pct, 2),
+        "noise_pct": round(noise_pct, 2),
+        "abba_verdict": verdict,
+        "bf16_emulated_on_backend": jax.default_backend() != "tpu",
+        "compile_lowerings_fp32_arm": lower32,
+        "compile_lowerings_bf16_arm": lower16,
+        "compute_copy_bytes": {
+            "params_fp32": param_elems * 4,
+            "params_bf16": param_elems * 2,
+            "batch_fp32": batch_elems * 4,
+            "batch_bf16": batch_elems * 2,
+            "reduction": 2.0,
+        },
+        "master_params_stay_fp32": bool(master_fp32),
+        "steps_timed": n * max(windows, 1),
+    }
 
 
 def bench_md(n_target: int = 8000, n_steps: int = 50) -> dict:
@@ -2463,6 +2688,14 @@ def child_main(status_path: str) -> None:
     # priority classes/shedding on vs off — both CPU-provable
     plan.append(("fleet_serving_ab", lambda: bench_fleet_serving_ab()))
     plan.append(("fleet_overload_ab", lambda: bench_fleet_overload_ab()))
+    # ISSUE 12 acceptance rows: the bf16 fast-path A/B (compile counts +
+    # cast-traffic bytes + honest ABBA on an emulating host) and the shared
+    # kernel-geometry autotuner (cold sweep -> cached choice -> warm zero
+    # cost -> chosen-vs-default ABBA) — both CPU-provable
+    plan.append(("bf16_train_ab",
+                 lambda: bench_bf16_train_ab(min(batch_size, 64),
+                                             bench_steps, warmup)))
+    plan.append(("autotune_ab", lambda: bench_autotune_ab()))
     if os.getenv("BENCH_FUSED_AUTOTUNE", "1") != "0":
         # cheap kernel-only sweep BEFORE the compile-heavy arch entries, so
         # a short window still yields the tuning data it was added for

@@ -347,7 +347,34 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     for key, val in config_defaults().items():
         res_cfg.setdefault(key, val)
     training.setdefault("loss_function_type", "mse")
+    # precision is validated against the step builders' known dtype set (plus
+    # the backend-resolved "auto" fast path) so a typo'd value fails at
+    # config load, not 40 s into the first TPU compile; HYDRAGNN_PRECISION
+    # overrides at step-build time (train.step.resolve_training_precision)
     training.setdefault("precision", "fp32")
+    from ..train.step import KNOWN_PRECISIONS
+
+    if str(training["precision"]) not in KNOWN_PRECISIONS:
+        raise ValueError(
+            f"Training.precision {training['precision']!r} not one of "
+            f"{sorted(KNOWN_PRECISIONS)}"
+        )
+    # static loss scale for fp16-class compute (train/step.py): 0/1 = off
+    # (the historical byte-identical program); validated here so a negative
+    # or non-numeric scale fails at load
+    training.setdefault("loss_scale", 0)
+    if (
+        isinstance(training["loss_scale"], bool)
+        or not isinstance(training["loss_scale"], (int, float))
+        # json.loads admits NaN/Infinity literals; a non-finite scale would
+        # NaN every gradient at step time instead of failing here
+        or not math.isfinite(float(training["loss_scale"]))
+        or float(training["loss_scale"]) < 0
+    ):
+        raise ValueError(
+            f"Training.loss_scale must be a finite number >= 0 (0/1 "
+            f"disables), got {training['loss_scale']!r}"
+        )
     training.setdefault("batch_size", 32)
     training.setdefault("Optimizer", {"type": "AdamW", "learning_rate": 1e-3})
     # per-member weight decays need the decay INJECTED as a runtime

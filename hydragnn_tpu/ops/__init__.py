@@ -4,6 +4,13 @@ windows + scalar prefetch, collate-certified geometry where a layout
 contract exists, an in-program (or static) XLA fallback, and
 ``interpret=True`` CPU testability behind a ``HYDRAGNN_*`` A/B flag."""
 
+from .autotune import (  # noqa: F401
+    autotune_cell_list,
+    autotune_gather_scatter,
+    autotune_quant_dense,
+    autotune_softmax,
+)
+from .fp8_matmul import certify_fp8_dense, fp8_dense  # noqa: F401
 from .fused_cell_list import fused_binned_radius_graph  # noqa: F401
 from .fused_scatter import fused_gather_scatter, gather_scatter_sum  # noqa: F401
 from .fused_softmax import (  # noqa: F401
@@ -13,6 +20,12 @@ from .fused_softmax import (  # noqa: F401
 from .quant_matmul import quant_dense, quantize_weight  # noqa: F401
 
 __all__ = [
+    "autotune_cell_list",
+    "autotune_gather_scatter",
+    "autotune_quant_dense",
+    "autotune_softmax",
+    "certify_fp8_dense",
+    "fp8_dense",
     "fused_binned_radius_graph",
     "fused_gather_scatter",
     "fused_masked_softmax",

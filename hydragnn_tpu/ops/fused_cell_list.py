@@ -163,16 +163,37 @@ def fused_binned_radius_graph(
     capacity: int,
     pad_id: int = 0,
     interpret: bool | None = None,
+    window: int | None = None,
 ):
     """Fused-kernel twin of ``md.binned_radius_graph`` — same arguments,
     same ``(senders, receivers, shifts, edge_mask, n_edges)`` contract (edge
     ORDER differs: cell-major, documented above). Returns ``None`` when the
     static geometry checks rule the kernel out; the caller then runs the
-    XLA path. ``grid``/``capacity`` come from ``md.plan_cell_grid``."""
+    XLA path. ``grid``/``capacity`` come from ``md.plan_cell_grid``.
+
+    ``window`` overrides the per-cell window width (autotuner axis; default
+    ``cell_window(capacity)``). Any 8-aligned width at or above that minimum
+    is exact — the in-kernel (first, count) membership check means window
+    slack can never admit or drop an atom; when ``HYDRAGNN_OPS_AUTOTUNE`` is
+    set, a cached per-shape choice from ``ops/autotune.py`` is used."""
     n = pos.shape[0]
     gx, gy, gz = (int(g) for g in grid)
     n_cells = gx * gy * gz
-    w = cell_window(int(capacity))
+    base = cell_window(int(capacity))
+    w = base
+    if window is not None:
+        w = int(window)
+        if w < base or w % 8:
+            raise ValueError(
+                f"window must be an 8-aligned width >= cell_window(capacity)"
+                f"={base}, got {w}"
+            )
+    else:
+        from .autotune import tuned_cell_list_window
+
+        tuned = tuned_cell_list_window(n, n_cells, int(capacity))
+        if tuned is not None:
+            w = tuned
     if not _static_ok(n, n_cells, w):
         return None
     if interpret is None:

@@ -497,7 +497,13 @@ def gather_scatter_sum(
     """Conv-stack entry point: fused kernel when enabled (flag/env/backend
     auto), XLA gather+``segment_sum`` otherwise. ``hints`` is the source
     ``GraphBatch``: its collate-certified ``BatchMeta.gs_fits`` makes the
-    kernel-vs-fallback choice trace-time static (no cond under vmap)."""
+    kernel-vs-fallback choice trace-time static (no cond under vmap).
+
+    With ``HYDRAGNN_OPS_AUTOTUNE`` set, a cached per-shape geometry from
+    the shared autotuner replaces the default — but only when the default
+    certificate provably transfers to it (``autotune.gs_cert_compatible``:
+    same block, wider window), so the certified static path survives the
+    geometry swap. The lookup is one in-memory dict read at trace time."""
     if fused is None:
         fused = _auto_enabled()
     if fused:
@@ -507,6 +513,18 @@ def gather_scatter_sum(
                 fits = hints.meta.gs_fits
             elif senders is hints.receivers and receivers is hints.senders:
                 fits = hints.meta.gs_fits  # transposed flow: same certificate
+        from .autotune import tuned_gather_scatter_geometry
+
+        tuned = tuned_gather_scatter_geometry(
+            num_nodes, senders.shape[0], h.shape[1], h.dtype
+        )
+        if tuned is not None:
+            window, block_edges = tuned
+            return fused_gather_scatter(
+                h, senders, receivers, num_nodes, weight, fits=fits,
+                window=window, block_edges=block_edges,
+                cert_geometry=(window, block_edges),
+            )
         return fused_gather_scatter(h, senders, receivers, num_nodes, weight, fits=fits)
     out = reference_gather_scatter(h, senders, receivers, num_nodes, weight)
     return out.astype(h.dtype)

@@ -97,41 +97,58 @@ def quant_dense(
     bias: Array | None = None,
     kernel: bool | None = None,
     interpret: bool | None = None,
+    row_block: int | None = None,
 ) -> Array:
     """Quantized dense layer ``[M, K] × int8 [K, N] → fp32 [M, N]`` with the
     activation scale ``s_x`` baked as a compile-time constant (one executable
-    per (model, bucket) — exactly the serving tier's AOT table shape)."""
+    per (model, bucket) — exactly the serving tier's AOT table shape).
+
+    ``row_block`` is the kernel's only free geometry (rows per grid step,
+    multiple of 8; default 8) — the axis the shared autotuner
+    (``ops/autotune.py``) sweeps. Dense rows carry no layout contract, so
+    any admissible block is exact; eligibility (VMEM, row count) is checked
+    at the REQUESTED block. When ``row_block`` is None and
+    ``HYDRAGNN_OPS_AUTOTUNE`` is set, a cached per-shape choice from the
+    shared autotuner replaces the default (one dict read at trace time)."""
     if kernel is None:
         kernel = jax.default_backend() == "tpu"
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if row_block is None:
+        from .autotune import tuned_quant_row_block
+
+        row_block = tuned_quant_row_block(x.shape[0], x.shape[1], w_q.shape[1])
+    rb = _ROW_BLOCK if row_block is None else int(row_block)
+    if rb < _ROW_BLOCK or rb % _ROW_BLOCK:
+        raise ValueError(f"row_block must be a positive multiple of "
+                         f"{_ROW_BLOCK}, got {rb}")
     s_x = float(s_x)
     m, k = x.shape
     n = w_q.shape[1]
     eligible = (
         kernel
         and pltpu is not None
-        and m >= _ROW_BLOCK
-        and (k * n + _ROW_BLOCK * (k + 2 * n)) * 4 <= _VMEM_LIMIT
+        and m >= rb
+        and (k * n + rb * (k + 2 * n)) * 4 <= _VMEM_LIMIT
         and jnp.issubdtype(x.dtype, jnp.floating)
     )
     if not eligible:
         return reference_quant_dense(x, w_q, s_w, s_x, bias)
     b = (bias if bias is not None else jnp.zeros((n,), jnp.float32))
-    m_pad = -m % _ROW_BLOCK
+    m_pad = -m % rb
     if m_pad:
         x = jnp.pad(x, ((0, m_pad), (0, 0)))
-    g = x.shape[0] // _ROW_BLOCK
+    g = x.shape[0] // rb
     out = pl.pallas_call(
         functools.partial(_quant_kernel, s_x=s_x),
         grid=(g,),
         in_specs=[
-            pl.BlockSpec((_ROW_BLOCK, k), lambda i: (i, 0)),
+            pl.BlockSpec((rb, k), lambda i: (i, 0)),
             pl.BlockSpec((k, n), lambda i: (0, 0)),  # weights resident
             pl.BlockSpec((1, n), lambda i: (0, 0)),
             pl.BlockSpec((1, n), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((_ROW_BLOCK, n), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((rb, n), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((x.shape[0], n), jnp.float32),
         interpret=interpret,
     )(x, w_q, s_w.astype(jnp.float32).reshape(1, n),

@@ -121,13 +121,16 @@ class Resilience:
         harness."""
         import jax.numpy as jnp
 
-        from ..train.step import resolve_precision
+        from ..train.step import resolve_training_precision
         from ..utils import flags
 
         cfg = dict(training_cfg.get("resilience") or {})
         guard = cfg.get("nonfinite_guard", "auto")
         if guard == "auto" or guard is None:
-            precision = resolve_precision(training_cfg.get("precision", "fp32"))
+            # the RESOLVED dtype (HYDRAGNN_PRECISION wins over the config,
+            # "auto" resolves per backend), so flipping a run to bf16/fp16
+            # via the env arms the guard exactly as a config edit would
+            precision = resolve_training_precision(training_cfg)
             guard = jnp.dtype(precision).itemsize < 4  # bf16/fp16-class only
         guard = bool(guard)
         env_guard = flags.get(flags.NONFINITE_GUARD)

@@ -27,7 +27,13 @@ from ..utils import flags
 from ..utils import tracer as tr
 from .checkpoint import Checkpoint, EarlyStopping, save_checkpoint
 from .optimizer import ReduceLROnPlateau, get_learning_rate, set_learning_rate
-from .step import TrainState, make_eval_step, make_train_step, resolve_precision
+from .step import (
+    TrainState,
+    make_eval_step,
+    make_train_step,
+    resolve_loss_scale,
+    resolve_training_precision,
+)
 
 
 def _max_num_batches(loader) -> int:
@@ -528,7 +534,8 @@ def train_validate_test(
 
     training = config_nn["Training"]
     num_epoch = int(training["num_epoch"])
-    precision = resolve_precision(training.get("precision", "fp32"))
+    precision = resolve_training_precision(training)
+    loss_scale = resolve_loss_scale(training)
     edge_sharded = bool(config_nn.get("Architecture", {}).get("edge_sharding"))
     res = resilience if resilience is not None else Resilience.from_config(training)
 
@@ -602,8 +609,21 @@ def train_validate_test(
         train_step = make_mlip_train_step(model, optimizer, compute_dtype=precision)
         eval_step = make_mlip_eval_step(model, compute_dtype=precision)
     else:
-        train_step = make_train_step(model, optimizer, compute_dtype=precision)
+        train_step = make_train_step(
+            model, optimizer, compute_dtype=precision, loss_scale=loss_scale
+        )
         eval_step = make_eval_step(model, compute_dtype=precision)
+    if loss_scale is not None and not (
+        mesh is None and not model.spec.enable_interatomic_potential
+    ):
+        # the scaling hook lives in the single-device step builder; the
+        # mesh/MLIP/pipeline factories ignore it — say so instead of
+        # silently training unscaled fp16
+        print_distributed(
+            verbosity,
+            f"Training.loss_scale={loss_scale} is only wired into the "
+            "single-device train step; this mode trains UNSCALED",
+        )
 
     # Non-finite step guard (resilience/guard.py): wrap the train step —
     # whichever mode built it — so a NaN/Inf loss or an exploded update is

@@ -60,7 +60,8 @@ from .step import (
     make_eval_step,
     make_train_step,
     make_weighted_train_step,
-    resolve_precision,
+    resolve_loss_scale,
+    resolve_training_precision,
 )
 from .superstep import make_superstep, resolve_steps_per_dispatch, select_state
 
@@ -379,7 +380,7 @@ def fit_population(
 
     training = config_nn["Training"]
     num_epoch = int(training["num_epoch"])
-    precision = resolve_precision(training.get("precision", "fp32"))
+    precision = resolve_training_precision(training)
     n = int(n_members)
     if n < 1:
         raise ValueError(f"population training needs >= 1 member, got {n}")
@@ -392,9 +393,15 @@ def fit_population(
                 f"got {len(task_weights)} task-weight rows for {n} members"
             )
         tw = [_normalize_task_weights(row, n_tasks) for row in task_weights]
-        step = make_weighted_train_step(model, optimizer, compute_dtype=precision)
+        step = make_weighted_train_step(
+            model, optimizer, compute_dtype=precision,
+            loss_scale=resolve_loss_scale(training),
+        )
     else:
-        step = make_train_step(model, optimizer, compute_dtype=precision)
+        step = make_train_step(
+            model, optimizer, compute_dtype=precision,
+            loss_scale=resolve_loss_scale(training),
+        )
     pop_step = make_population_step(step, task_weights=tw)
     k = resolve_steps_per_dispatch(training)
     dispatch_step = make_superstep(pop_step, k) if k > 1 else pop_step
@@ -540,7 +547,7 @@ def train_population(
     from .loop import evaluate
 
     if flags.get(flags.VALTEST) and len(getattr(test_loader, "samples", ())):
-        precision = resolve_precision(training.get("precision", "fp32"))
+        precision = resolve_training_precision(training)
         eval_step = make_population_eval_step(model, compute_dtype=precision)
         test_loss, _, test_rmse = evaluate(
             eval_step, pstate.state, test_loader, verbosity, span="test",

@@ -31,14 +31,54 @@ PRECISION_MAP = {
     "float64": jnp.float64,
     "bf16": jnp.bfloat16,
     "bfloat16": jnp.bfloat16,
+    "fp16": jnp.float16,
+    "float16": jnp.float16,
 }
+
+# Every value Training.precision may take (config/schema.py validates against
+# THIS set at load time, so a typo fails before any compile). "auto" is the
+# backend-resolved fast path: bf16 compute (fp32 master weights) on TPU —
+# the MXU's native reduced-precision format — and fp32 everywhere else, so
+# CPU CI keeps its bit-exact parity gates while TPU runs get the fast path
+# without a per-deployment config edit.
+KNOWN_PRECISIONS = frozenset(PRECISION_MAP) | {"auto"}
 
 
 def resolve_precision(name: str):
+    if name == "auto":
+        return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
     try:
         return PRECISION_MAP[name]
     except KeyError:
-        raise ValueError(f"Unknown precision '{name}'; one of {sorted(PRECISION_MAP)}")
+        raise ValueError(
+            f"Unknown precision '{name}'; one of {sorted(KNOWN_PRECISIONS)}"
+        )
+
+
+def resolve_training_precision(training_cfg: dict):
+    """The single env-aware resolver for the training stack's compute dtype:
+    ``HYDRAGNN_PRECISION`` > ``Training.precision`` > fp32. Every consumer
+    that builds step programs from a Training config (the epoch loop, the
+    population engine, the non-finite guard's auto-arming) must resolve
+    through HERE, so the env override changes all of them coherently — a
+    flag that switched the step to bf16 but left the guard disarmed would
+    silently drop the divergence protection the bf16 path is documented to
+    carry."""
+    from ..utils import flags
+
+    name = flags.get(
+        flags.PRECISION,
+        default=str(training_cfg.get("precision", "fp32") or "fp32"),
+    )
+    return resolve_precision(str(name))
+
+
+def resolve_loss_scale(training_cfg: dict) -> float | None:
+    """Static loss scale for fp16-class compute: ``Training.loss_scale``
+    (0/1/unset disables). Returns None when disabled so step builders can
+    keep the historical (byte-identical) program on the default path."""
+    scale = float(training_cfg.get("loss_scale", 0) or 0)
+    return scale if scale not in (0.0, 1.0) else None
 
 
 class TrainState(NamedTuple):
@@ -118,7 +158,7 @@ def donate_state_argnums() -> tuple:
         return ()
 
 
-def _make_step_impl(model: HydraModel, optimizer, compute_dtype):
+def _make_step_impl(model: HydraModel, optimizer, compute_dtype, loss_scale=None):
     """The shared (unjitted) train-step body behind :func:`make_train_step`
     and :func:`make_weighted_train_step`. ``task_weights=None`` is the
     static path — byte-for-byte the historical step program (total loss from
@@ -126,7 +166,16 @@ def _make_step_impl(model: HydraModel, optimizer, compute_dtype):
     ``task_weights`` re-weights the SAME per-task losses in the SAME
     accumulation order, so a traced vector equal to the spec weights is
     bit-identical to the static path — the contract the population layer's
-    per-member loss weights rely on."""
+    per-member loss weights rely on.
+
+    ``loss_scale`` (static, baked at build time; None/1 disables and keeps
+    the historical program byte-for-byte): multiply the loss before the
+    backward pass and un-scale the fp32-cast gradients before the optimizer
+    — the classic static scaling fp16-class dtypes need so small gradients
+    survive fp16's 5-bit exponent. bf16 shares fp32's exponent range and
+    never needs it; metrics always report the UNSCALED loss. Prefer
+    powers of two so the un-scale divide is exact."""
+    loss_scale = None if not loss_scale or float(loss_scale) == 1.0 else float(loss_scale)
 
     def loss_fn(params, batch_stats, batch: GraphBatch, dropout_rng, task_weights):
         c_params = _cast_floats(params, compute_dtype)
@@ -163,14 +212,27 @@ def _make_step_impl(model: HydraModel, optimizer, compute_dtype):
             tot = 0.0
             for ihead, task_loss in enumerate(tasks):
                 tot = tot + task_loss * task_weights[ihead]
+        if loss_scale is not None:
+            # differentiate the scaled loss; ride the unscaled one out via
+            # aux so metrics never see the scale
+            return tot * loss_scale, ((tot, tasks), updates["batch_stats"])
         return tot, (tasks, updates["batch_stats"])
 
     def step_impl(state: TrainState, batch: GraphBatch, task_weights):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
-        (tot, (tasks, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (tot, (aux, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, batch, dropout_rng, task_weights
         )
-        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
+        grads = _cast_floats(grads, jnp.float32)
+        if loss_scale is not None:
+            tot, tasks = aux
+            # un-scale AFTER the fp32 cast: the whole point is that the
+            # scaled backward kept tiny values above fp16's underflow, and
+            # fp32 has the range to divide back exactly (2^k scales)
+            grads = jax.tree.map(lambda g: g / loss_scale, grads)
+        else:
+            tasks = aux
+        grads = freeze_conv_grads(grads, model.spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
@@ -189,10 +251,13 @@ def _make_step_impl(model: HydraModel, optimizer, compute_dtype):
     return step_impl
 
 
-def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
+def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32,
+                    loss_scale=None):
     """Build the jitted single-device train step:
-    (state, batch) -> (state, metrics dict)."""
-    step_impl = _make_step_impl(model, optimizer, compute_dtype)
+    (state, batch) -> (state, metrics dict). ``loss_scale`` as in
+    :func:`_make_step_impl` (fp16-class static scaling; None/1 = historical
+    program)."""
+    step_impl = _make_step_impl(model, optimizer, compute_dtype, loss_scale)
 
     @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
     def train_step(state: TrainState, batch: GraphBatch):
@@ -201,7 +266,8 @@ def make_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
     return train_step
 
 
-def make_weighted_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
+def make_weighted_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32,
+                             loss_scale=None):
     """Like :func:`make_train_step` but with TRACED task weights:
     ``(state, batch, task_weights[n_tasks]) -> (state, metrics)``.
 
@@ -211,7 +277,7 @@ def make_weighted_train_step(model: HydraModel, optimizer, compute_dtype=jnp.flo
     weights / heteroscedastic ensembles) without N recompiles. Callers pass
     weights normalized the way ``ModelSpec`` normalizes ``task_weights``
     (w / sum|w|) if they want parity with a statically-weighted run."""
-    step_impl = _make_step_impl(model, optimizer, compute_dtype)
+    step_impl = _make_step_impl(model, optimizer, compute_dtype, loss_scale)
 
     @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
     def train_step(state: TrainState, batch: GraphBatch, task_weights):

@@ -190,7 +190,35 @@ FLEET_CACHE_BYTES = _register(Flag(
     "graph is answered from the router, byte-identical to replica "
     "compute, at zero replica cost."))
 
+# -- precision --------------------------------------------------------------
+PRECISION = _register(Flag(
+    "HYDRAGNN_PRECISION", "str", None,
+    "Compute dtype for training step programs (overrides "
+    "Training.precision): fp32/fp64/bf16/fp16 (+ long aliases) or 'auto' "
+    "(bf16 on TPU backends, fp32 elsewhere). Master weights, gradients, "
+    "optimizer state, and checkpoints stay fp32 regardless — the flag "
+    "changes the per-step cast-to-compute only, and the non-finite guard's "
+    "'auto' policy arms itself off the RESOLVED dtype, so forcing bf16/fp16 "
+    "here also arms the divergence guard. fp16-class runs can add a static "
+    "Training.loss_scale; bf16 never needs one."))
+
 # -- kernels / compilation --------------------------------------------------
+OPS_AUTOTUNE = _register(Flag(
+    "HYDRAGNN_OPS_AUTOTUNE", "bool", False,
+    "Let ops/ kernel wrappers consult the shared geometry autotuner's "
+    "on-disk cache (ops/autotune.py; persisted next to "
+    "HYDRAGNN_COMPILE_CACHE as ops_autotune.json). A cached per-(kernel, "
+    "shape, backend) choice replaces the hard-coded default geometry when "
+    "its layout certificate provably transfers; cache misses keep the "
+    "default — sweeps only ever run through explicit autotune_* calls "
+    "(bench/tooling), never implicitly inside a training step."))
+FP8_MATMUL = _register(Flag(
+    "HYDRAGNN_FP8_MATMUL", "bool", None,
+    "EXPERIMENTAL: route ops.fp8_matmul.fp8_dense through its fused Pallas "
+    "kernel (default: on for TPU backends, XLA expression elsewhere). The "
+    "fp8 (e4m3/e5m2) dense path is an opt-in experiment with certified "
+    "error reporting (certify_fp8_dense) — it is NOT a Training.precision "
+    "value and nothing routes through it implicitly."))
 FUSED_SCATTER = _register(Flag(
     "HYDRAGNN_FUSED_SCATTER", "bool", None,
     "Force the Pallas fused gather-scatter kernel on/off (default: on for "

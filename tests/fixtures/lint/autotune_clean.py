@@ -1,0 +1,74 @@
+"""Sanctioned autotuner patterns (ops/autotune.py): the timing loop and the
+cached-geometry lookup are HOST-side driver code and must stay GL-silent:
+
+- ``jax.block_until_ready`` brackets each timing window in plain Python —
+  never inside (or reachable from) a jitted function (GL001 flags
+  jit-reachable host syncs, not host drivers);
+- every candidate's jitted callable is built ONCE, before its timing
+  windows, and reused across windows and pairs (GL003 jit-in-loop stays
+  quiet: the loop re-INVOKES, it never re-builds);
+- the per-shape cache lookup happens at trace time on static Python ints
+  (shapes), is branched on as a host value, and the resulting geometry is
+  baked into the trace (GL002 never sees a traced conditional).
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _time_window(fn, args, reps):
+    # host timing bracket: compile outside the window, sync at its edges
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def sweep_candidates(x, geometries, reps=4, pairs=2):
+    """The autotuner's ABBA shape: jitted candidates built ONCE up front,
+    then only invoked inside the interleaved timing windows."""
+    builds = {
+        g: jax.jit(lambda v, _g=g: (v * _g).sum()) for g in geometries
+    }
+    incumbent = geometries[0]
+    for cand in geometries[1:]:
+        a_ms, b_ms = [], []
+        for w in range(pairs):
+            if w % 2 == 0:
+                a_ms.append(_time_window(builds[incumbent], (x,), reps))
+                b_ms.append(_time_window(builds[cand], (x,), reps))
+            else:
+                b_ms.append(_time_window(builds[cand], (x,), reps))
+                a_ms.append(_time_window(builds[incumbent], (x,), reps))
+        if sorted(b_ms)[len(b_ms) // 2] < sorted(a_ms)[len(a_ms) // 2]:
+            incumbent = cand
+    return incumbent
+
+
+_CACHE = {}
+
+
+def record(path, kernel, sig, geometry):
+    # host-side JSON persistence: plain file IO, no traced values involved
+    _CACHE[f"{kernel}|{sig}"] = geometry
+    with open(path, "w") as f:
+        json.dump(_CACHE, f)
+
+
+def tuned_kernel(x, num_nodes):
+    """Trace-time lookup: the shape is a static Python int, the cached
+    geometry is a host value baked into the returned program."""
+    geometry = _CACHE.get(f"k|{num_nodes}")  # host dict read at trace time
+    if geometry is None:  # host branch on a host value — not GL002
+        geometry = 256
+    return jnp.tanh(x / geometry)
+
+
+@jax.jit
+def model_step(x):
+    return tuned_kernel(x, 256).sum()

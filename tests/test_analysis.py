@@ -153,6 +153,16 @@ def test_pallas_kernel_wrappers_are_clean():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_autotuner_timing_loop_is_clean():
+    """The kernel-geometry autotuner's shape (ops/autotune.py: host ABBA
+    timing windows bracketed by block_until_ready, jitted candidates built
+    once before the loop, JSON cache IO, trace-time static geometry lookup)
+    is sanctioned host driver code: every rule — GL001's jit-reachable
+    host-sync hunt above all — must stay silent on it."""
+    findings = analyze([str(FIXTURES / "autotune_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_fleet_router_thread_socket_code_is_clean():
     """The fleet tier's shape (serve/fleet: dispatcher threads popping
     host queues, watchdog/socket round-trips, pre-compiled executables

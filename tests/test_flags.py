@@ -104,6 +104,41 @@ def test_fleet_flags_reach_fleet_config(monkeypatch):
     assert flags.warn_unknown() == []
 
 
+def test_precision_and_autotune_flags(monkeypatch):
+    """HYDRAGNN_PRECISION / HYDRAGNN_OPS_AUTOTUNE / HYDRAGNN_FP8_MATMUL are
+    typed, registered, and land on their consumers with env-beats-config
+    precedence (the fleet-flag contract)."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops import autotune as at
+    from hydragnn_tpu.train.step import resolve_training_precision
+
+    monkeypatch.delenv("HYDRAGNN_PRECISION", raising=False)
+    monkeypatch.delenv("HYDRAGNN_OPS_AUTOTUNE", raising=False)
+    monkeypatch.delenv("HYDRAGNN_FP8_MATMUL", raising=False)
+    assert flags.get(flags.PRECISION) is None
+    assert flags.get(flags.OPS_AUTOTUNE) is False  # sweeps are opt-in
+    assert flags.get(flags.FP8_MATMUL) is None
+
+    # env beats an explicit config value
+    assert resolve_training_precision({"precision": "fp64"}) == jnp.float64
+    monkeypatch.setenv("HYDRAGNN_PRECISION", "bf16")
+    assert flags.get(flags.PRECISION) == "bf16"
+    assert resolve_training_precision({"precision": "fp64"}) == jnp.bfloat16
+
+    assert at.enabled() is False
+    monkeypatch.setenv("HYDRAGNN_OPS_AUTOTUNE", "1")
+    assert at.enabled() is True
+    monkeypatch.setenv("HYDRAGNN_OPS_AUTOTUNE", "0")
+    assert at.enabled() is False
+
+    out = flags.describe()
+    for name in ("HYDRAGNN_PRECISION", "HYDRAGNN_OPS_AUTOTUNE",
+                 "HYDRAGNN_FP8_MATMUL"):
+        assert name in out
+    assert flags.warn_unknown() == []
+
+
 def test_affinity_pinning_smoke(monkeypatch):
     """AFFINITY pins collate workers (reference load_data.py:121-136) —
     smoke: a pinned worker thread ends up with a 1-core affinity mask."""

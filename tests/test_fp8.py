@@ -1,0 +1,98 @@
+"""fp8 (e4m3/e5m2) matmul experiments (ISSUE 12, ``ops/fp8_matmul.py``).
+
+Experimental by contract: one arithmetic definition (``reference_fp8_dense``,
+the kernel must match it), certified error reporting on every input
+(``certify_fp8_dense`` — the serving tier's certify-before-serve discipline),
+format-structure sanity (e4m3's extra mantissa bit beats e5m2 on in-range
+data), and the schema gate that keeps fp8 OUT of ``Training.precision``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.ops import fp8_matmul as f8
+
+
+def _xwb(m=32, k=16, n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.normal(size=(m, k)), jnp.float32),
+        jnp.asarray(rng.normal(size=(k, n)), jnp.float32),
+        jnp.asarray(rng.normal(size=(n,)), jnp.float32),
+    )
+
+
+def test_formats_resolve_and_unknown_raises():
+    assert f8.resolve_fp8_format("e4m3") == jnp.float8_e4m3fn
+    assert f8.resolve_fp8_format("e5m2") == jnp.float8_e5m2
+    with pytest.raises(ValueError, match="e4m3"):
+        f8.resolve_fp8_format("e3m4")
+
+
+@pytest.mark.parametrize("fmt", ["e4m3", "e5m2"])
+def test_certified_error_is_reported_and_bounded(fmt):
+    x, w, b = _xwb()
+    cert = f8.certify_fp8_dense(x, w, b, fmt)
+    assert cert["format"] == fmt
+    assert np.isfinite(cert["max_abs_err"])
+    # per-channel weight scales + per-tensor activation scale keep a
+    # Gaussian matmul within a few percent relative error — the quantized
+    # answer must be recognizably the fp32 one, not noise
+    assert 0 < cert["rel_fro_err"] < 0.2
+
+
+def test_e4m3_beats_e5m2_on_in_range_data():
+    # 3 vs 2 mantissa bits: on data far from either format's range limit
+    # the forward format must be strictly more accurate
+    x, w, b = _xwb(seed=7)
+    e4 = f8.certify_fp8_dense(x, w, b, "e4m3")["rel_fro_err"]
+    e5 = f8.certify_fp8_dense(x, w, b, "e5m2")["rel_fro_err"]
+    assert e4 < e5
+
+
+@pytest.mark.parametrize("fmt", ["e4m3", "e5m2"])
+def test_kernel_route_matches_reference(fmt):
+    x, w, b = _xwb(seed=3)
+    w_q, s_w = f8.quantize_weight_fp8(w, fmt)
+    s_x = f8.activation_scale_fp8(x, fmt)
+    ref = f8.reference_fp8_dense(x, w_q, s_w, s_x, b, fmt)
+    ker = f8.fp8_dense(x, w, b, fmt=fmt, s_x=float(s_x), kernel=True,
+                       interpret=True)
+    # one arithmetic, two execution routes (~1-ulp dequant/bias fusion,
+    # same contract as quant_matmul)
+    np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flag_routes_kernel_choice(monkeypatch):
+    x, w, b = _xwb(seed=5)
+    # flag off: the XLA expression (kernel=None resolves through the flag)
+    monkeypatch.setenv("HYDRAGNN_FP8_MATMUL", "0")
+    off = f8.fp8_dense(x, w, b, fmt="e4m3", interpret=True)
+    w_q, s_w = f8.quantize_weight_fp8(w, "e4m3")
+    ref = f8.reference_fp8_dense(x, w_q, s_w, f8.activation_scale_fp8(x, "e4m3"),
+                                 b, "e4m3")
+    np.testing.assert_array_equal(np.asarray(off), np.asarray(ref))
+    # flag on: the kernel route, same arithmetic
+    monkeypatch.setenv("HYDRAGNN_FP8_MATMUL", "1")
+    on = f8.fp8_dense(x, w, b, fmt="e4m3", interpret=True)
+    np.testing.assert_allclose(np.asarray(on), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_saturating_quantization_never_makes_inf():
+    # e5m2 HAS an inf encoding; the clip-before-cast convention must keep
+    # over-range values saturated instead
+    x = jnp.asarray([[1e9, -1e9, 0.5, -0.5]], jnp.float32)
+    for fmt in ("e4m3", "e5m2"):
+        q = f8._quantize_fp8(x, fmt, f8.resolve_fp8_format(fmt))
+        assert np.all(np.isfinite(np.asarray(q, np.float32)))
+
+
+def test_fp8_is_not_a_training_precision():
+    from hydragnn_tpu.train.step import resolve_precision
+
+    for name in ("fp8", "e4m3", "e5m2", "float8"):
+        with pytest.raises(ValueError):
+            resolve_precision(name)

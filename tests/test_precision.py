@@ -1,6 +1,13 @@
 """Precision policy plumbing (reference ``tests/test_precision_control.py`` +
 ``train_validate_test.py:43-71`` PRECISION_MAP): fp32 master params with
-cast-to-compute, every alias resolving, fp64 opt-in."""
+cast-to-compute, every alias resolving, fp64 opt-in.
+
+PR 12 (ISSUE 12) widened this into the bf16 fast-path gate: schema-validated
+precision values, ``HYDRAGNN_PRECISION`` env precedence (including the
+non-finite guard's auto-arming off the RESOLVED dtype), fp16 + static loss
+scaling, and the fp32-master-weight invariant proven through population
+vmap and checkpoint/resume (master weights fp32 ON DISK, resume bit-exact).
+"""
 
 import copy
 
@@ -10,11 +17,13 @@ import numpy as np
 import pytest
 
 from hydragnn_tpu.train.step import (
+    KNOWN_PRECISIONS,
     PRECISION_MAP,
     _cast_floats,
     create_train_state,
     make_train_step,
     resolve_precision,
+    resolve_training_precision,
 )
 
 
@@ -42,7 +51,13 @@ def test_cast_floats_only_touches_floats():
     assert out["ids"].dtype == jnp.int32
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
 def _tiny_setup():
+    """Built once per process (read-only for tests): model/optimizer/batch.
+    States are created per test."""
     from hydragnn_tpu.config import update_config
     from hydragnn_tpu.datasets import deterministic_graph_data
     from hydragnn_tpu.graphs.batching import GraphLoader
@@ -60,10 +75,18 @@ def _tiny_setup():
     return model, opt, batch
 
 
+@functools.lru_cache(maxsize=None)
+def _shared_step(dtype_name):
+    """ONE jitted step per compute dtype, shared across tests so its
+    compiled program is paid for once (CPU never donates; sharing is safe)."""
+    model, opt, _ = _tiny_setup()
+    return make_train_step(model, opt, compute_dtype=PRECISION_MAP[dtype_name])
+
+
 def test_bf16_compute_keeps_fp32_master_params():
     model, opt, batch = _tiny_setup()
     state = create_train_state(model, opt, batch)
-    step = make_train_step(model, opt, compute_dtype=jnp.bfloat16)
+    step = _shared_step("bf16")
     state2, metrics = step(state, batch)
     # master params and gradients-applied params stay fp32
     for leaf in jax.tree.leaves(state2.params):
@@ -77,6 +100,200 @@ def test_bf16_compute_keeps_fp32_master_params():
 def test_bf16_and_fp32_losses_agree_roughly():
     model, opt, batch = _tiny_setup()
     state = create_train_state(model, opt, batch)
-    l32 = float(make_train_step(model, opt, jnp.float32)(state, batch)[1]["loss"])
-    l16 = float(make_train_step(model, opt, jnp.bfloat16)(state, batch)[1]["loss"])
+    l32 = float(_shared_step("fp32")(state, batch)[1]["loss"])
+    l16 = float(_shared_step("bf16")(state, batch)[1]["loss"])
     assert l16 == pytest.approx(l32, rel=0.05)
+
+
+# ---------------------------------------------------------------------------
+# PR 12: schema validation, env precedence, loss scaling, e2e invariants
+# ---------------------------------------------------------------------------
+
+
+def test_schema_rejects_unknown_precision():
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from test_config import CI_CONFIG
+
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["NeuralNetwork"].setdefault("Training", {})["precision"] = "bf17"
+    samples = deterministic_graph_data(number_configurations=4, seed=0)
+    with pytest.raises(ValueError, match="Training.precision"):
+        update_config(cfg, samples)
+    # every documented value (incl. the backend-resolved fast path) passes
+    for name in sorted(KNOWN_PRECISIONS):
+        ok = copy.deepcopy(CI_CONFIG)
+        ok["NeuralNetwork"].setdefault("Training", {})["precision"] = name
+        update_config(ok, samples)
+
+
+def test_schema_validates_loss_scale():
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from test_config import CI_CONFIG
+
+    samples = deterministic_graph_data(number_configurations=4, seed=0)
+    bad = copy.deepcopy(CI_CONFIG)
+    bad["NeuralNetwork"].setdefault("Training", {})["loss_scale"] = -2
+    with pytest.raises(ValueError, match="loss_scale"):
+        update_config(bad, samples)
+    bad["NeuralNetwork"]["Training"]["loss_scale"] = "big"
+    with pytest.raises(ValueError, match="loss_scale"):
+        update_config(bad, samples)
+    # json.loads admits NaN/Infinity literals — they must fail at load,
+    # not NaN every gradient at step time
+    for nonfinite in (float("nan"), float("inf")):
+        bad["NeuralNetwork"]["Training"]["loss_scale"] = nonfinite
+        with pytest.raises(ValueError, match="loss_scale"):
+            update_config(bad, samples)
+    ok = copy.deepcopy(CI_CONFIG)
+    ok["NeuralNetwork"].setdefault("Training", {})["loss_scale"] = 1024
+    aug = update_config(ok, samples)
+    assert aug["NeuralNetwork"]["Training"]["loss_scale"] == 1024
+
+
+def test_precision_env_flag_overrides_config(monkeypatch):
+    monkeypatch.delenv("HYDRAGNN_PRECISION", raising=False)
+    assert resolve_training_precision({"precision": "fp32"}) == jnp.float32
+    monkeypatch.setenv("HYDRAGNN_PRECISION", "bf16")
+    assert resolve_training_precision({"precision": "fp32"}) == jnp.bfloat16
+    # empty-but-set counts as unset (the registry convention)
+    monkeypatch.setenv("HYDRAGNN_PRECISION", "")
+    assert resolve_training_precision({"precision": "fp16"}) == jnp.float16
+    # "auto" resolves per backend: fp32 on this CPU host
+    monkeypatch.setenv("HYDRAGNN_PRECISION", "auto")
+    assert resolve_training_precision({"precision": "fp32"}) == (
+        jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    )
+
+
+def test_env_precision_arms_nonfinite_guard(monkeypatch):
+    """The guard's 'auto' policy keys off the RESOLVED dtype: forcing bf16
+    via the env must arm it exactly as the config edit would — otherwise
+    the flag would silently drop the divergence protection the bf16 path
+    documents."""
+    from hydragnn_tpu.resilience import Resilience
+
+    monkeypatch.delenv("HYDRAGNN_PRECISION", raising=False)
+    monkeypatch.delenv("HYDRAGNN_NONFINITE_GUARD", raising=False)
+    assert Resilience.from_config({"precision": "fp32"}).guard_enabled is False
+    assert Resilience.from_config({"precision": "bf16"}).guard_enabled is True
+    monkeypatch.setenv("HYDRAGNN_PRECISION", "bf16")
+    assert Resilience.from_config({"precision": "fp32"}).guard_enabled is True
+    monkeypatch.setenv("HYDRAGNN_PRECISION", "fp16")
+    assert Resilience.from_config({"precision": "fp32"}).guard_enabled is True
+    # an explicit guard switch still wins over the auto policy
+    monkeypatch.setenv("HYDRAGNN_NONFINITE_GUARD", "0")
+    assert Resilience.from_config({"precision": "fp32"}).guard_enabled is False
+
+
+def test_loss_scale_matches_unscaled_exactly():
+    """Static loss scaling is numerically transparent in fp32 for 2^k
+    scales: grad(S·f)/S == grad(f) exactly (multiply/divide by a power of
+    two is exact on normal floats), and the reported loss is the UNSCALED
+    one carried through aux."""
+    model, opt, batch = _tiny_setup()
+    state = create_train_state(model, opt, batch)
+    plain = _shared_step("fp32")
+    scaled = make_train_step(model, opt, jnp.float32, loss_scale=1024.0)
+    s_plain, m_plain = plain(state, batch)
+    s_scaled, m_scaled = scaled(state, batch)
+    assert float(m_plain["loss"]) == float(m_scaled["loss"])
+    for a, b in zip(jax.tree.leaves(s_plain.params),
+                    jax.tree.leaves(s_scaled.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # loss_scale=1 short-circuits to the historical program
+    one = make_train_step(model, opt, jnp.float32, loss_scale=1.0)
+    s_one, _ = one(state, batch)
+    for a, b in zip(jax.tree.leaves(s_plain.params), jax.tree.leaves(s_one.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fp16_with_loss_scale_trains_finite():
+    model, opt, batch = _tiny_setup()
+    state = create_train_state(model, opt, batch)
+    step = make_train_step(model, opt, jnp.float16, loss_scale=256.0)
+    state2, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    for leaf in jax.tree.leaves(state2.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32  # master weights stay fp32
+
+
+def _master_fp32(tree):
+    return all(
+        np.asarray(x).dtype == np.float32
+        for x in jax.tree.leaves(tree)
+        if np.issubdtype(np.asarray(x).dtype, np.floating)
+    )
+
+
+@pytest.mark.slow
+def test_bf16_population_parity_and_master_weights():
+    """ISSUE 12 gate: a vmapped bf16 population reproduces sequential bf16
+    members (allclose — vmap batching may reassociate reductions) and every
+    float leaf of the stacked params AND optimizer state stays fp32.
+    Slow-marked up front (~6 s: the vmapped program's compile) per the
+    tier-1 budget rule; the fp32-master invariant also has non-slow
+    coverage via the single-state and checkpoint gates."""
+    from hydragnn_tpu.train import (
+        create_population_state,
+        make_population_step,
+        member_state,
+    )
+
+    model, opt, batch = _tiny_setup()
+    step = _shared_step("bf16")
+    pop_step = make_population_step(step)
+    n = 2
+    pstate = create_population_state(model, opt, batch, n, seeds=[0, 1])
+    assert _master_fp32(pstate.state.params)
+    assert _master_fp32(pstate.state.opt_state)
+    # sequential refs from the SAME per-member initial states
+    refs = []
+    for i in range(n):
+        s = member_state(pstate, i)
+        for _ in range(2):
+            s, _ = step(s, batch)
+        refs.append(s)
+    p = pstate
+    for _ in range(2):
+        p, _ = pop_step(p, batch)
+    assert _master_fp32(p.state.params)
+    assert _master_fp32(p.state.opt_state)
+    for i, ref in enumerate(refs):
+        got = member_state(p, i)
+        for a, b in zip(jax.tree.leaves(ref.params), jax.tree.leaves(got.params)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_checkpoint_fp32_on_disk_and_bitexact_resume(tmp_path):
+    """ISSUE 12 gate: after bf16 training steps the checkpoint payload is
+    the fp32 master state — fp32 dtypes on disk — and a restore + continue
+    bit-matches the uninterrupted run (the resume contract reduced
+    precision must not weaken: the per-step cast is derived state, nothing
+    lossy is persisted)."""
+    from hydragnn_tpu.train.checkpoint import load_checkpoint, save_checkpoint
+
+    model, opt, batch = _tiny_setup()
+    step = _shared_step("bf16")
+    state = create_train_state(model, opt, batch)
+    for _ in range(2):
+        state, _ = step(state, batch)
+    save_checkpoint(state, "bf16_ckpt", epoch=0, path=str(tmp_path))
+
+    template = create_train_state(model, opt, batch)
+    restored, meta = load_checkpoint(template, "bf16_ckpt", path=str(tmp_path))
+    assert _master_fp32(restored.params)
+    assert _master_fp32(restored.opt_state)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # continue one step from the restore vs the uninterrupted state:
+    # bit-identical params and metrics
+    cont, m_cont = step(restored, batch)
+    base, m_base = step(state, batch)
+    assert float(m_cont["loss"]) == float(m_base["loss"])
+    for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(cont)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
