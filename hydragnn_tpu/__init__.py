@@ -34,6 +34,19 @@ def _honor_platform_env() -> None:
 
 _honor_platform_env()
 
+
+def _maybe_enable_threadsan() -> None:
+    """HYDRAGNN_THREADSAN=1: instrument every lock the package creates from
+    import time on (analysis/threadsan.py) — whole-process lock-order
+    sanitizing for chaos/soak runs; tests use the ``threadsan`` fixture."""
+    if _os.environ.get("HYDRAGNN_THREADSAN", "") not in ("", "0"):
+        from .analysis import threadsan
+
+        threadsan.maybe_enable_from_env()
+
+
+_maybe_enable_threadsan()
+
 from . import graphs  # noqa: F401,E402
 
 __version__ = "0.1.0"

@@ -8,6 +8,16 @@ dict pytrees, donated-buffer reuse, mutable-default / cache-aliased state)
 over the package with a shared whole-package symbol-resolution pass.
 Grandfathered findings live in ``baseline.json`` with per-entry reasons.
 
+Concurrency side: rules GL101-GL107 (``rules_concurrency.py`` — guarded
+attribute writes without their documented ``# guarded-by:`` lock, static
+lock-order cycles, Condition.wait outside a while-predicate, blocking
+calls under a lock, wall-clock deadline arithmetic, unowned threads,
+guarded-state reference escapes) run through the same registry, and
+``threadsan.py`` is their runtime complement: an opt-in lock-order
+sanitizer (``HYDRAGNN_THREADSAN=1`` / the ``threadsan`` pytest fixture)
+recording the real acquisition-order graph and reporting potential
+deadlocks with both stacks.
+
 Runtime side: :func:`no_recompile` / the ``compile_sentinel`` pytest fixture
 assert a region triggers no more jit cache misses than declared, via
 ``jax.monitoring`` counters.
@@ -17,6 +27,7 @@ See ``hydragnn_tpu/analysis/README.md`` for the rule catalogue.
 
 from .core import Finding, analyze, load_baseline, split_new
 from .sentinel import RecompileError, compile_counts, no_recompile
+from .threadsan import LockOrderError, ThreadSanitizer
 
 __all__ = [
     "Finding",
@@ -26,4 +37,6 @@ __all__ = [
     "RecompileError",
     "compile_counts",
     "no_recompile",
+    "LockOrderError",
+    "ThreadSanitizer",
 ]

@@ -25,7 +25,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hydragnn_tpu.analysis",
         description="graftlint: JAX/TPU-aware static analysis "
-        "(rules GL001-GL007; see hydragnn_tpu/analysis/README.md)",
+        "(jit rules GL001-GL007 + concurrency rules GL101-GL107; "
+        "see hydragnn_tpu/analysis/README.md)",
     )
     ap.add_argument("paths", nargs="*", default=None, help="files/dirs to scan "
                     "(default: the hydragnn_tpu package)")
@@ -39,8 +40,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-baseline", metavar="PATH",
                     help="write current findings to PATH as a baseline "
                     "(reasons stamped UNREVIEWED; justify each before committing)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="output format; json emits {summary, new, "
+                    "baselined} for machine consumption (CI annotators, "
+                    "dashboards)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="machine-readable output")
+                    help="alias for --format=json (kept for callers of the "
+                    "original flag)")
     ap.add_argument("--no-suppress", action="store_true",
                     help="ignore '# graftlint: disable=' comments")
     args = ap.parse_args(argv)
@@ -89,9 +95,18 @@ def main(argv: list[str] | None = None) -> int:
             return 2
     new, baselined = split_new(findings, entries)
 
-    if args.as_json:
+    if args.as_json or args.format == "json":
+        by_rule: dict[str, int] = {}
+        for f in new:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
         print(json.dumps(
             {
+                "summary": {
+                    "new": len(new),
+                    "baselined": len(baselined),
+                    "new_by_rule": by_rule,
+                    "fail": bool(new),
+                },
                 "new": [f.to_json() for f in new],
                 "baselined": [f.to_json() for f in baselined],
             },

@@ -18,7 +18,7 @@ import dataclasses
 import json
 import os
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable(?P<scope>-next|-file)?=(?P<ids>(?:GL\d{3}|all)(?:\s*,\s*(?:GL\d{3}|all))*)"
@@ -42,6 +42,63 @@ class Finding:
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
+
+
+# Shared rule plumbing lives HERE (not in rules.py) so that both rule
+# modules — rules.py and rules_concurrency.py — can import it without
+# importing each other: rules.py's bottom-of-file registration import of
+# rules_concurrency would otherwise be circular with a top-of-file import
+# in the opposite direction.
+
+
+@dataclass
+class RuleContext:
+    """Shared, precomputed state handed to every rule."""
+
+    index: "PackageIndex"  # noqa: F821 — annotation only (symbols.py)
+    jit_contexts: list = field(default_factory=list)
+
+
+def find_cycles(edge_keys) -> list[list[str]]:
+    """Enumerate cycles in a directed graph given as ``(a, b)`` edge keys.
+
+    Returns each cycle as a node path closed back on its start
+    (``[a, b, a]``), deduplicated by node SET so rotations of one cycle
+    report once, in deterministic (sorted-start) order. Shared by GL102's
+    static lock-order graph and threadsan's runtime acquisition graph —
+    one algorithm, two edge payloads."""
+    adj: dict[str, list[str]] = {}
+    for a, b in edge_keys:
+        adj.setdefault(a, []).append(b)
+    cycles: list[list[str]] = []
+    seen: set[frozenset] = set()
+    for start in sorted(adj):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, []):
+                if nxt == start:
+                    key = frozenset(path)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    cycles.append(path + [start])
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return cycles
+
+
+def _finding(rule: str, mod, node, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    snippet = mod.lines[line - 1].strip() if 0 < line <= len(mod.lines) else ""
+    return Finding(
+        rule=rule,
+        path=mod.display_path,
+        line=line,
+        col=getattr(node, "col_offset", 0) + 1,
+        message=message,
+        snippet=snippet,
+    )
 
 
 def parse_suppressions(lines: list[str]) -> tuple[set[str], dict[int, set[str]]]:

@@ -10,31 +10,12 @@ package for the bad/good example of every rule and the suppression syntax.
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass, field
 
-from .core import Finding
+# RuleContext/_finding live in core.py (shared with rules_concurrency.py,
+# which must not import THIS module — see the registration import at the
+# bottom); re-exported here for back-compat.
+from .core import Finding, RuleContext, _finding  # noqa: F401
 from .symbols import JIT_WRAPPERS, FunctionInfo, JitContext, ModuleInfo, PackageIndex
-
-
-@dataclass
-class RuleContext:
-    """Shared, precomputed state handed to every rule."""
-
-    index: PackageIndex
-    jit_contexts: list[JitContext] = field(default_factory=list)
-
-
-def _finding(rule: str, mod: ModuleInfo, node: ast.AST, message: str) -> Finding:
-    line = getattr(node, "lineno", 1)
-    snippet = mod.lines[line - 1].strip() if 0 < line <= len(mod.lines) else ""
-    return Finding(
-        rule=rule,
-        path=mod.display_path,
-        line=line,
-        col=getattr(node, "col_offset", 0) + 1,
-        message=message,
-        snippet=snippet,
-    )
 
 
 # ---------------------------------------------------------------------------
@@ -720,5 +701,13 @@ ALL_RULES = [
     GL006DonatedRead(),
     GL007AliasedState(),
 ]
+
+# the GL1xx concurrency family (rules_concurrency.py) registers through the
+# same ALL_RULES/RULES_BY_ID tables, so the CLI, the baseline machinery and
+# the tier-1 --fail-on-new gate cover it with zero extra wiring. Imported at
+# the bottom: rules_concurrency depends on RuleContext/_finding above.
+from .rules_concurrency import CONCURRENCY_RULES  # noqa: E402
+
+ALL_RULES += CONCURRENCY_RULES
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

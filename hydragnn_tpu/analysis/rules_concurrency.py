@@ -1,0 +1,886 @@
+"""graftlint rules GL101-GL107 — thread-safety hazards in the repo's
+hand-rolled concurrent plane (serve/, fleet/, wire, sharded store, watchdog).
+
+The reference HydraGNN leans on ADIOS2/MPI for its concurrent infrastructure;
+this rebuild wrote that plane in-repo, so these rules give threads the same
+treatment GL001-GL007 gave jit: whole classes of concurrency bugs become
+unrepresentable in CI instead of latent until a bad box window.
+
+Conventions the rules are driven by (documented in ``analysis/README.md``):
+
+* ``# guarded-by: <lock>`` on an ``__init__`` attribute assignment declares
+  that ``self.<attr>`` may only be MUTATED while ``self.<lock>`` is held
+  (GL101) and must not escape by reference (GL107). ``<lock>`` may be dotted
+  (``_health.lock``) for locks owned by a member object.
+* A method whose name ends in ``_locked`` asserts "caller holds the lock" —
+  it is exempt from GL101's held-lock requirement (the call sites inside
+  ``with`` blocks are still checked).
+* ``__init__`` (and ``__new__``/``__del__``) are construction/teardown:
+  the object is not yet / no longer shared, so GL101 does not apply there.
+
+Static scope: the walkers are one-level lexical (no interprocedural lock
+tracking) — exactly the scope the runtime sanitizer (``threadsan.py``)
+complements dynamically.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .core import Finding, RuleContext, _finding, find_cycles
+from .symbols import ModuleInfo, PackageIndex
+
+# -- shared lock/guard discovery ---------------------------------------------
+
+#: constructors whose result is an acquirable lock (Condition acquires its
+#: underlying mutex, so it guards data exactly like a Lock)
+_LOCK_FACTORIES = {
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+}
+_COND_FACTORY = "threading.Condition"
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+
+#: method calls that mutate a container in place (the writes GL101 protects)
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "add", "remove", "discard", "pop", "popleft", "popitem",
+    "clear", "update", "setdefault", "move_to_end", "sort",
+    "reverse", "rotate", "__setitem__",
+}
+
+#: initializers that make an attribute a MUTABLE container (GL107 only
+#: worries about reference escapes of mutable state; an int counter or a
+#: None placeholder cannot alias)
+_MUTABLE_CTORS = {
+    "list", "dict", "set", "deque", "bytearray",
+    "OrderedDict", "defaultdict", "Counter", "WeakValueDictionary",
+}
+
+
+def _self_attr_chain(node: ast.expr) -> str | None:
+    """``self.X`` -> "X", ``self.X.Y`` -> "X.Y"; None for anything not
+    rooted at a literal ``self``."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and parts:
+        return ".".join(reversed(parts))
+    return None
+
+
+def _lock_key(node: ast.expr, class_name: str | None) -> str | None:
+    """Stable identity for a lock expression inside a ``with``: self
+    attributes are scoped to the class (two classes' ``self._lock`` are
+    different locks), bare names are module globals, and ``obj.attr``
+    chains keep their textual spelling."""
+    chain = _self_attr_chain(node)
+    if chain is not None:
+        return f"{class_name or '?'}.self.{chain}"
+    if isinstance(node, ast.Name):
+        return f"<module>.{node.id}"
+    # outer._conns_lock style: name-rooted attribute chain
+    parts: list[str] = []
+    n = node
+    while isinstance(n, ast.Attribute):
+        parts.append(n.attr)
+        n = n.value
+    if isinstance(n, ast.Name):
+        return f"<module>.{n.id}." + ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class ClassLocks:
+    """Per-class lock/guard declarations harvested from its methods."""
+
+    node: ast.ClassDef
+    name: str
+    lock_attrs: set[str] = field(default_factory=set)   # incl. conditions
+    cond_attrs: set[str] = field(default_factory=set)
+    alias: dict[str, str] = field(default_factory=dict)  # cond -> its mutex
+    # guarded attr -> (lock name as written in the annotation, decl line)
+    guarded: dict[str, tuple[str, int]] = field(default_factory=dict)
+    # guarded attrs whose initializer is a mutable container (GL107 scope)
+    mutable: set[str] = field(default_factory=set)
+
+    def canonical(self, lock: str) -> set[str]:
+        """A held lock name plus everything it implies: acquiring a
+        Condition acquires its underlying mutex (and vice versa for
+        guarding purposes — both serialize on the same mutex)."""
+        out = {lock}
+        if lock in self.alias:
+            out.add(self.alias[lock])
+        for cond, mutex in self.alias.items():
+            if mutex == lock:
+                out.add(cond)
+        return out
+
+
+def _is_mutable_init(value: ast.expr, mod: ModuleInfo) -> bool:
+    if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(value, ast.Call):
+        fname = None
+        if isinstance(value.func, ast.Name):
+            fname = value.func.id
+        elif isinstance(value.func, ast.Attribute):
+            fname = value.func.attr
+        return fname in _MUTABLE_CTORS
+    return False
+
+
+def _collect_class_locks(mod: ModuleInfo, cls: ast.ClassDef) -> ClassLocks:
+    info = ClassLocks(node=cls, name=cls.name)
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for stmt in ast.walk(item):
+            if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets = [stmt.target]
+                value = stmt.value
+            elif isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+                value = stmt.value
+            else:
+                continue
+            for t in targets:
+                attr = _self_attr_chain(t)
+                if attr is None or "." in attr:
+                    continue
+                if isinstance(value, ast.Call):
+                    dotted = mod.resolve_dotted(value.func)
+                    # aliased factories (`_REAL_LOCK = threading.Lock` —
+                    # the threadsan pattern) are recognized by name
+                    fname = (
+                        value.func.id if isinstance(value.func, ast.Name)
+                        else ""
+                    )
+                    is_lock = dotted in _LOCK_FACTORIES or (
+                        "lock" in fname.lower() or "condition" in fname.lower()
+                    )
+                    if is_lock:
+                        info.lock_attrs.add(attr)
+                        if (
+                            dotted == _COND_FACTORY
+                            or "condition" in fname.lower()
+                        ):
+                            info.cond_attrs.add(attr)
+                            if value.args:
+                                mutex = _self_attr_chain(value.args[0])
+                                if mutex is not None:
+                                    info.alias[attr] = mutex
+                line = stmt.lineno
+                if 0 < line <= len(mod.lines):
+                    m = _GUARDED_BY_RE.search(mod.lines[line - 1])
+                    if m:
+                        info.guarded[attr] = (m.group(1), line)
+                        if _is_mutable_init(value, mod):
+                            info.mutable.add(attr)
+    return info
+
+
+def _iter_classes(mod: ModuleInfo):
+    """Every ClassDef in the module, including nested ones (the WireServer
+    pattern defines handler classes inside __init__)."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def _methods(cls: ast.ClassDef):
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield item
+
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__", "__post_init__"}
+
+
+def _mutations(stmt: ast.stmt):
+    """(attr chain or None, node) pairs for every self-attribute mutation in
+    a SIMPLE statement: assignment/augassign/del targets rooted at self.X,
+    and in-place mutator calls ``self.X.append(...)``. The attr returned is
+    the BASE attribute (``self.X[...] = v`` and ``self.X.Y = v`` both
+    mutate the object bound to X)."""
+    out: list[tuple[str, ast.AST]] = []
+
+    def target_base(t: ast.expr) -> str | None:
+        # unwrap subscripts/attributes down to the self.<attr> base
+        node = t
+        saw_wrap = False
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            if isinstance(node, ast.Subscript):
+                saw_wrap = True
+                node = node.value
+            else:
+                chain = _self_attr_chain(node)
+                if chain is not None:
+                    return chain.split(".")[0]
+                saw_wrap = True
+                node = node.value
+        if isinstance(node, ast.Name) and node.id == "self":
+            return None
+        return None
+
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = stmt.targets
+    for t in targets:
+        chain = _self_attr_chain(t)
+        if chain is not None:
+            out.append((chain.split(".")[0], t))
+            continue
+        base = target_base(t)
+        if base is not None:
+            out.append((base, t))
+    for node in ast.walk(stmt):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATORS
+        ):
+            recv = node.func.value
+            # unwrap subscripts: self.X[k].append(v) mutates X's contents
+            while isinstance(recv, ast.Subscript):
+                recv = recv.value
+            chain = _self_attr_chain(recv)
+            if chain is not None:
+                out.append((chain.split(".")[0], node))
+    return out
+
+
+def _with_locks(stmt: ast.With | ast.AsyncWith, class_name: str | None):
+    """Lock keys (and self-attr names) acquired by a with statement."""
+    keys: list[tuple[str, ast.expr]] = []
+    for item in stmt.items:
+        key = _lock_key(item.context_expr, class_name)
+        if key is not None:
+            keys.append((key, item.context_expr))
+    return keys
+
+
+# ---------------------------------------------------------------------------
+
+
+class GL101GuardedWrite:
+    id = "GL101"
+    title = "guarded attribute mutated without its documented lock held"
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        for cls in _iter_classes(mod):
+            info = _collect_class_locks(mod, cls)
+            if not info.guarded:
+                continue
+            # typo guard: an annotation naming a lock the class never
+            # constructs (and that is not dotted — member-object locks
+            # can't be verified statically) protects nothing
+            for attr, (lock, line) in info.guarded.items():
+                if "." not in lock and lock not in info.lock_attrs:
+                    out.append(Finding(
+                        rule=self.id, path=mod.display_path, line=line, col=1,
+                        message=(
+                            f"'{attr}' is annotated guarded-by: {lock}, but "
+                            f"{cls.name} constructs no lock attribute "
+                            f"'{lock}' — a typo'd guard protects nothing"
+                        ),
+                        snippet=mod.lines[line - 1].strip()
+                        if 0 < line <= len(mod.lines) else "",
+                    ))
+            for meth in _methods(cls):
+                if meth.name in _EXEMPT_METHODS or meth.name.endswith("_locked"):
+                    continue
+                out.extend(self._check_method(mod, cls, info, meth))
+        return out
+
+    def _check_method(self, mod, cls, info: ClassLocks, meth):
+        out = []
+
+        def walk(stmts, held: frozenset):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # nested defs run in another context/time
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    acquired: set[str] = set()
+                    for key, expr in _with_locks(stmt, cls.name):
+                        chain = _self_attr_chain(expr)
+                        if chain is not None:
+                            acquired |= info.canonical(chain)
+                        else:
+                            acquired.add(key)
+                    walk(stmt.body, held | frozenset(acquired))
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    check_simple(stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) else stmt.test, held)
+                    walk(stmt.body, held)
+                    walk(stmt.orelse, held)
+                    continue
+                if isinstance(stmt, ast.If):
+                    check_simple(stmt.test, held)
+                    walk(stmt.body, held)
+                    walk(stmt.orelse, held)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    walk(stmt.body, held)
+                    for h in stmt.handlers:
+                        walk(h.body, held)
+                    walk(stmt.orelse, held)
+                    walk(stmt.finalbody, held)
+                    continue
+                check_stmt(stmt, held)
+
+        def check_simple(expr, held):
+            # mutator calls can hide in loop iterables / if tests
+            if expr is None:
+                return
+            shim = ast.Expr(value=expr)
+            ast.copy_location(shim, expr)
+            check_stmt(shim, held)
+
+        def check_stmt(stmt, held):
+            for attr, node in _mutations(stmt):
+                entry = info.guarded.get(attr)
+                if entry is None:
+                    continue
+                lock, _ = entry
+                if not (info.canonical(lock) & held):
+                    out.append(_finding(
+                        self.id, mod, node,
+                        f"'{attr}' is documented guarded-by: {lock} "
+                        f"(see {cls.name}.__init__), but this write in "
+                        f"{meth.name}() happens without the lock held — "
+                        f"wrap it in `with self.{lock}:` (or rename the "
+                        "method *_locked if the caller holds it)",
+                    ))
+
+        walk(meth.body, frozenset())
+        return out
+
+
+class GL102LockOrder:
+    id = "GL102"
+    title = "inconsistent lock acquisition order (potential deadlock)"
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        # edges: (outer, inner) -> (line, col, context qualname)
+        edges: dict[tuple[str, str], tuple[int, int, str]] = {}
+
+        def scan_function(fn, class_name: str | None, qual: str):
+            def walk(stmts, held: list):
+                for stmt in stmts:
+                    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                        continue
+                    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        keys = [k for k, _ in _with_locks(stmt, class_name)]
+                        for outer in held:
+                            for inner in keys:
+                                if inner != outer:
+                                    edges.setdefault(
+                                        (outer, inner),
+                                        (stmt.lineno, stmt.col_offset + 1, qual),
+                                    )
+                        walk(stmt.body, held + keys)
+                        continue
+                    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While, ast.If)):
+                        walk(stmt.body, held)
+                        walk(stmt.orelse, held)
+                    elif isinstance(stmt, ast.Try):
+                        walk(stmt.body, held)
+                        for h in stmt.handlers:
+                            walk(h.body, held)
+                        walk(stmt.orelse, held)
+                        walk(stmt.finalbody, held)
+
+            walk(fn.body, [])
+
+        for cls in _iter_classes(mod):
+            for meth in _methods(cls):
+                scan_function(meth, cls.name, f"{cls.name}.{meth.name}")
+        class_method_ids = {
+            id(m) for cls in _iter_classes(mod) for m in _methods(cls)
+        }
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and id(node) not in class_method_ids
+            ):
+                scan_function(node, None, node.name)
+
+        # cycle hunt over the module-wide acquisition graph
+        out = []
+        for cycle in find_cycles(edges):
+            sites = " ; ".join(
+                f"{a}->{b} at line {edges[(a, b)][0]} "
+                f"(in {edges[(a, b)][2]})"
+                for a, b in zip(cycle, cycle[1:])
+            )
+            line, col, _ = edges[(cycle[0], cycle[1])]
+            snippet = (
+                mod.lines[line - 1].strip()
+                if 0 < line <= len(mod.lines) else ""
+            )
+            out.append(Finding(
+                rule=self.id, path=mod.display_path,
+                line=line, col=col,
+                message=(
+                    "lock acquisition order cycle "
+                    + " -> ".join(cycle)
+                    + f" [{sites}] — two threads taking these "
+                    "locks in opposite orders deadlock; pick ONE "
+                    "global order and stick to it"
+                ),
+                snippet=snippet,
+            ))
+        out.sort(key=lambda f: (f.line, f.col))
+        return out
+
+
+class GL103WaitWithoutWhile:
+    id = "GL103"
+    title = "Condition.wait outside a while-predicate loop"
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        cond_attrs: set[str] = set()
+        for cls in _iter_classes(mod):
+            cond_attrs |= _collect_class_locks(mod, cls).cond_attrs
+
+        def local_conds(fn) -> set[str]:
+            names = set()
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                    if mod.resolve_dotted(stmt.value.func) == _COND_FACTORY:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                names.add(t.id)
+            return names
+
+        def is_condition(expr: ast.expr, conds_local: set[str]) -> bool:
+            chain = _self_attr_chain(expr)
+            if chain is not None:
+                return chain in cond_attrs
+            return isinstance(expr, ast.Name) and expr.id in conds_local
+
+        def scan(fn):
+            conds_local = local_conds(fn)
+
+            def walk(node, in_while: bool):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.ClassDef)):
+                        continue
+                    inside = in_while or isinstance(child, ast.While)
+                    if (
+                        isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Attribute)
+                        and child.func.attr == "wait"
+                        and is_condition(child.func.value, conds_local)
+                        and not in_while
+                    ):
+                        out.append(_finding(
+                            self.id, mod, child,
+                            "Condition.wait() outside a while-predicate "
+                            "loop: wakeups are SPURIOUS and notify can race "
+                            "the predicate — always `while not pred: "
+                            "cond.wait()` so the state is re-checked",
+                        ))
+                    if (
+                        isinstance(child, ast.Expr)
+                        and isinstance(child.value, ast.Call)
+                        and isinstance(child.value.func, ast.Attribute)
+                        and child.value.func.attr == "wait_for"
+                        and is_condition(child.value.func.value, conds_local)
+                    ):
+                        out.append(_finding(
+                            self.id, mod, child.value,
+                            "Condition.wait_for() result discarded: it "
+                            "returns False on timeout with the predicate "
+                            "still unmet — branch on the result (or the "
+                            "code proceeds on unready state)",
+                        ))
+                    walk(child, inside)
+
+            walk(fn, False)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(node)
+        # dedupe (nested function scans overlap)
+        seen: set[tuple] = set()
+        uniq = []
+        for f in out:
+            key = (f.line, f.col, f.message)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(f)
+        return uniq
+
+
+class GL104BlockingUnderLock:
+    id = "GL104"
+    title = "blocking call while holding a lock"
+
+    BLOCKING_DOTTED = {
+        "time.sleep",
+        "subprocess.run", "subprocess.call", "subprocess.check_call",
+        "subprocess.check_output", "subprocess.Popen",
+        "socket.create_connection",
+    }
+    BLOCKING_METHODS = {
+        "recv", "recv_into", "recvfrom", "accept", "connect", "sendall",
+        "result",
+    }
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+
+        def scan_function(fn, class_name: str | None, info: ClassLocks | None):
+            def walk(stmts, held: frozenset):
+                for stmt in stmts:
+                    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                         ast.ClassDef)):
+                        continue
+                    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        acquired: set[str] = set()
+                        for key, expr in _with_locks(stmt, class_name):
+                            acquired.add(key)
+                            chain = _self_attr_chain(expr)
+                            if chain is not None and info is not None:
+                                acquired |= {
+                                    f"{class_name}.self.{c}"
+                                    for c in info.canonical(chain)
+                                }
+                        walk(stmt.body, held | frozenset(acquired))
+                        continue
+                    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                        walk(stmt.body, held)
+                        walk(stmt.orelse, held)
+                        if held:
+                            check_calls(stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) else stmt.test, held)
+                        continue
+                    if isinstance(stmt, ast.If):
+                        if held:
+                            check_calls(stmt.test, held)
+                        walk(stmt.body, held)
+                        walk(stmt.orelse, held)
+                        continue
+                    if isinstance(stmt, ast.Try):
+                        walk(stmt.body, held)
+                        for h in stmt.handlers:
+                            walk(h.body, held)
+                        walk(stmt.orelse, held)
+                        walk(stmt.finalbody, held)
+                        continue
+                    if held:
+                        check_calls(stmt, held)
+
+            def check_calls(node, held):
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    dotted = mod.resolve_dotted(sub.func)
+                    if dotted in self.BLOCKING_DOTTED:
+                        out.append(_finding(
+                            self.id, mod, sub,
+                            f"{dotted}() blocks while lock(s) "
+                            f"{sorted(held)} are held — every other thread "
+                            "needing them stalls for the full wait; move "
+                            "the blocking call outside the critical section",
+                        ))
+                        continue
+                    if (
+                        isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in self.BLOCKING_METHODS
+                        and not isinstance(sub.func.value, ast.Constant)
+                    ):
+                        out.append(_finding(
+                            self.id, mod, sub,
+                            f".{sub.func.attr}() can block indefinitely "
+                            f"while lock(s) {sorted(held)} are held; "
+                            "release the lock around the blocking call "
+                            "(copy what you need under the lock first)",
+                        ))
+                        continue
+                    if (
+                        isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in ("wait", "wait_for")
+                        and info is not None
+                    ):
+                        chain = _self_attr_chain(sub.func.value)
+                        if chain is not None and chain in info.cond_attrs:
+                            own = {
+                                f"{class_name}.self.{c}"
+                                for c in info.canonical(chain)
+                            }
+                            foreign = held - own
+                            if foreign:
+                                out.append(_finding(
+                                    self.id, mod, sub,
+                                    f"Condition.wait on self.{chain} "
+                                    "releases only its OWN mutex; foreign "
+                                    f"lock(s) {sorted(foreign)} stay held "
+                                    "for the whole wait — a classic "
+                                    "deadlock shape; drop them first",
+                                ))
+
+            walk(fn.body, frozenset())
+
+        for cls in _iter_classes(mod):
+            info = _collect_class_locks(mod, cls)
+            for meth in _methods(cls):
+                scan_function(meth, cls.name, info)
+        class_method_ids = {
+            id(m) for cls in _iter_classes(mod) for m in _methods(cls)
+        }
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and id(node) not in class_method_ids
+            ):
+                scan_function(node, None, None)
+        # dedupe: nested function bodies are reachable from several walks
+        seen: set[tuple] = set()
+        uniq = []
+        for f in out:
+            key = (f.line, f.col)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(f)
+        uniq.sort(key=lambda f: (f.line, f.col))
+        return uniq
+
+
+class GL105WallClockDeadline:
+    id = "GL105"
+    title = "time.time() in deadline/timeout arithmetic"
+
+    _DEADLINE_NAME = re.compile(
+        r"deadline|timeout|expire|expiry|until|_at$|flush", re.IGNORECASE
+    )
+
+    def _deadline_ish(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return bool(self._DEADLINE_NAME.search(node.id))
+        if isinstance(node, ast.Attribute):
+            return bool(self._DEADLINE_NAME.search(node.attr))
+        return False
+
+    def _is_time_time(self, mod: ModuleInfo, node: ast.expr) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and mod.resolve_dotted(node.func) == "time.time"
+        )
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        msg = (
+            "time.time() is wall-clock: NTP steps/DST jumps move it "
+            "backwards or forwards, so deadlines computed from it "
+            "misfire or never fire — use time.monotonic() for "
+            "deadline/timeout arithmetic"
+        )
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                if any(self._deadline_ish(t) for t in node.targets) and any(
+                    self._is_time_time(mod, s) for s in ast.walk(node.value)
+                    if isinstance(s, ast.expr)
+                ):
+                    out.append(_finding(self.id, mod, node.value, msg))
+            elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Add, ast.Sub)
+            ):
+                pair = (node.left, node.right)
+                if any(self._is_time_time(mod, s) for s in pair) and any(
+                    self._deadline_ish(s) for s in pair
+                ):
+                    out.append(_finding(self.id, mod, node, msg))
+            elif isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                if any(self._is_time_time(mod, s) for s in sides) and any(
+                    self._deadline_ish(s) for s in sides
+                ):
+                    out.append(_finding(self.id, mod, node, msg))
+        # dedupe: `deadline = time.time() + timeout` matches Assign AND BinOp
+        seen: set[tuple] = set()
+        uniq = []
+        for f in sorted(out, key=lambda f: (f.line, f.col)):
+            if (f.line,) not in seen:
+                seen.add((f.line,))
+                uniq.append(f)
+        return uniq
+
+
+class GL106UnownedThread:
+    id = "GL106"
+    title = "thread started without join/daemon ownership"
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        # every `.join()` receiver in the module — enough to tell "joined
+        # somewhere" from "never" without tracking handle flow
+        joined: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+            ):
+                chain = _self_attr_chain(node.func.value)
+                if chain is not None:
+                    joined.add("self." + chain)
+                elif isinstance(node.func.value, ast.Name):
+                    joined.add(node.func.value.id)
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.Assign, ast.Expr)):
+                continue
+            calls = []
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                calls = [(node.value, node.targets)]
+            elif isinstance(node, ast.Expr):
+                # threading.Thread(...).start() anonymous form
+                v = node.value
+                if (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr == "start"
+                    and isinstance(v.func.value, ast.Call)
+                ):
+                    calls = [(v.func.value, [])]
+            for call, targets in calls:
+                if mod.resolve_dotted(call.func) != "threading.Thread":
+                    continue
+                daemon = next(
+                    (kw.value for kw in call.keywords if kw.arg == "daemon"),
+                    None,
+                )
+                if daemon is not None and not (
+                    isinstance(daemon, ast.Constant) and daemon.value is False
+                ):
+                    continue  # daemon=True (or dynamic): ownership declared
+                names = set()
+                for t in targets:
+                    chain = _self_attr_chain(t)
+                    if chain is not None:
+                        names.add("self." + chain)
+                    elif isinstance(t, ast.Name):
+                        names.add(t.id)
+                if names & joined:
+                    continue
+                out.append(_finding(
+                    self.id, mod, call,
+                    "thread is neither daemon=True nor join()ed anywhere in "
+                    "this module: it outlives its owner silently (leaks on "
+                    "shutdown, races teardown). Declare ownership — "
+                    "daemon=True with a stop flag, or keep the handle and "
+                    "join it",
+                ))
+        return out
+
+
+class GL107GuardedEscape:
+    id = "GL107"
+    title = "lock-protected state escaping by reference"
+
+    def check(self, mod: ModuleInfo, index: PackageIndex, ctx: RuleContext):
+        out = []
+        for cls in _iter_classes(mod):
+            info = _collect_class_locks(mod, cls)
+            if not info.mutable:
+                continue
+            for meth in _methods(cls):
+                if meth.name in _EXEMPT_METHODS:
+                    continue
+                out.extend(self._check_method(mod, cls, info, meth))
+        return out
+
+    def _check_method(self, mod, cls, info: ClassLocks, meth):
+        out = []
+        # one-hop aliases: plain `x = self.<guarded>` (no call in between)
+        aliases: dict[str, str] = {}
+        for stmt in ast.walk(meth):
+            if isinstance(stmt, ast.Assign):
+                chain = _self_attr_chain(stmt.value)
+                if chain is not None and chain.split(".")[0] in info.mutable:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            aliases[t.id] = chain.split(".")[0]
+
+        def escaping(expr: ast.expr):
+            """Sub-expressions the returned/yielded value aliases —
+            descends containers/ternaries but NOT calls (a call result is
+            presumed fresh, mirroring GL007), NOT a ternary's test (only
+            its branches are the value), and NOT comparisons/boolean tests
+            (their result is a bool, not a reference)."""
+            stack = [expr]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.Call, ast.Compare)):
+                    continue
+                if isinstance(n, ast.IfExp):
+                    stack.extend([n.body, n.orelse])
+                    continue
+                yield n
+                stack.extend(
+                    c for c in ast.iter_child_nodes(n)
+                    if isinstance(c, ast.expr)
+                )
+
+        for stmt in ast.walk(meth):
+            value = None
+            if isinstance(stmt, ast.Return):
+                value = stmt.value
+            elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Yield):
+                value = stmt.value.value
+            elif isinstance(stmt, ast.Yield):
+                value = stmt.value
+            if value is None:
+                continue
+            for sub in escaping(value):
+                attr = None
+                if isinstance(sub, ast.Attribute):
+                    chain = _self_attr_chain(sub)
+                    if chain is not None and chain.split(".")[0] in info.mutable:
+                        attr = chain.split(".")[0]
+                elif isinstance(sub, ast.Subscript):
+                    chain = _self_attr_chain(sub.value)
+                    if chain is not None and chain.split(".")[0] in info.mutable:
+                        attr = chain.split(".")[0]
+                elif isinstance(sub, ast.Name) and sub.id in aliases:
+                    attr = aliases[sub.id]
+                if attr is not None:
+                    lock = info.guarded[attr][0]
+                    out.append(_finding(
+                        self.id, mod, stmt,
+                        f"{meth.name}() returns/yields a reference into "
+                        f"'{attr}' (guarded-by: {lock}); once it escapes "
+                        "the lock, callers mutate shared state unguarded "
+                        "— return a copy (the ShardedStore cache-aliasing "
+                        "bug class)",
+                    ))
+                    break
+        return out
+
+
+CONCURRENCY_RULES = [
+    GL101GuardedWrite(),
+    GL102LockOrder(),
+    GL103WaitWithoutWhile(),
+    GL104BlockingUnderLock(),
+    GL105WallClockDeadline(),
+    GL106UnownedThread(),
+    GL107GuardedEscape(),
+]

@@ -1,0 +1,531 @@
+"""Runtime lock-order sanitizer — the dynamic half of the GL1xx family.
+
+The static rules (``rules_concurrency.py``) see one lexical level; this
+module watches what the locks actually DO: while enabled, every
+``threading.Lock`` / ``RLock`` / ``Condition`` *constructed* is wrapped in
+an instrumentation shim that records, per thread,
+
+* the **acquisition-order graph**: an edge ``A -> B`` whenever a thread
+  acquires ``B`` while holding ``A``, with the stack of BOTH acquisitions
+  captured at first observation — so a cycle report names the two code
+  paths that disagree about the order, not just the locks;
+* **hold-while-blocking events**: a ``Condition.wait`` entered while a
+  *different* sanitized lock is held (the wait releases only its own
+  mutex; the foreign lock stays held for the whole wait — the classic
+  lost-wakeup/deadlock shape GL104 hunts statically).
+
+``check_cycles()`` walks the graph for cycles; ``assert_clean()`` raises
+:class:`LockOrderError` with both stacks per conflicting edge, turning
+"deadlock on a bad box window" into a deterministic test failure.
+
+Design notes:
+
+* Graph nodes are **creation sites** (``file:line`` of the lock's
+  constructor), not instances — ten thousand per-request ``Future``
+  conditions collapse into one node, the graph stays tiny, and a cycle is
+  meaningful across instances. Same-site edges with *distinct* instances
+  (two queues of one class acquired nested) are recorded as
+  ``instance_hazards`` but deliberately NOT failed by ``assert_clean`` —
+  without a global instance order they are suspicion, not proof.
+* Only locks created **while enabled** are instrumented (opt-in scope:
+  enable before building the server/store under test). Locks that predate
+  enablement — jax internals, import machinery — stay native.
+* The shims stay correct after :func:`disable`: they keep delegating to
+  their real lock and merely stop recording, so daemon threads outliving
+  a test can't break.
+
+Activation: the ``threadsan`` pytest fixture (conftest re-export), an
+explicit ``enable()``/``disable()`` pair, or ``HYDRAGNN_THREADSAN=1`` in
+the environment (``maybe_enable_from_env`` — called at package import) for
+whole-process runs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from contextlib import contextmanager
+
+from .core import find_cycles
+
+# the REAL factories, captured at import time — the sanitizer's own state
+# must never run through its own shims
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+_STACK_LIMIT = 14
+
+
+class LockOrderError(AssertionError):
+    """A lock-order cycle (potential deadlock) was observed at runtime."""
+
+
+def _site() -> str:
+    """file:line of the nearest caller frame outside this module — the
+    lock's CREATION site, the graph's node identity."""
+    here = os.path.dirname(__file__)
+    for frame in reversed(traceback.extract_stack(limit=24)):
+        if not frame.filename.startswith(here):
+            short = os.sep.join(frame.filename.split(os.sep)[-3:])
+            return f"{short}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _stack() -> list[str]:
+    here = os.path.dirname(__file__)
+    frames = [
+        f for f in traceback.extract_stack(limit=_STACK_LIMIT + 6)
+        if not f.filename.startswith(here)
+        and os.sep + "threading.py" not in f.filename
+    ]
+    return [
+        f"{os.sep.join(f.filename.split(os.sep)[-3:])}:{f.lineno} in {f.name}"
+        for f in frames[-_STACK_LIMIT:]
+    ]
+
+
+class ThreadSanitizer:
+    """Collects the acquisition-order graph for every shimmed lock."""
+
+    MAX_EDGES = 10_000  # runaway backstop; far above any real test's graph
+
+    def __init__(self):
+        self._mu = _REAL_LOCK()
+        self.enabled = False
+        self._tls = threading.local()
+        # (site_a, site_b) -> {"stack_a", "stack_b", "thread", "instances"}
+        self.edges: dict = {}  # guarded-by: _mu
+        self.hold_while_blocking: list = []  # guarded-by: _mu
+        self.instance_hazards: list = []  # guarded-by: _mu
+        self._hazard_sites: set = set()  # guarded-by: _mu
+        self.n_locks = 0  # guarded-by: _mu
+
+    # -- per-thread held list -------------------------------------------------
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquired(self, shim: "_SanLock") -> None:
+        if not self.enabled:
+            return
+        held = self._held()
+        stack = _stack()
+        for outer_shim, outer_stack in held:
+            if outer_shim is shim:
+                continue
+            key = (outer_shim.site, shim.site)
+            with self._mu:
+                if key in self.edges or len(self.edges) >= self.MAX_EDGES:
+                    continue
+                if outer_shim.site == shim.site:
+                    # same creation site, different instances: ordering
+                    # hazard unless callers impose a global instance order
+                    # — surfaced as data, not an assert_clean failure.
+                    # First observation per site only (same discipline as
+                    # edges): a hot per-request path nesting two same-site
+                    # locks must not grow this list per acquisition
+                    if shim.site not in self._hazard_sites:
+                        self._hazard_sites.add(shim.site)
+                        self.instance_hazards.append({
+                            "site": shim.site,
+                            "thread": threading.current_thread().name,
+                            "stack": stack,
+                        })
+                    continue
+                self.edges[key] = {
+                    "stack_outer": list(outer_stack),
+                    "stack_inner": stack,
+                    "thread": threading.current_thread().name,
+                }
+        held.append((shim, stack))
+
+    def note_released(self, shim: "_SanLock") -> None:
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is shim:
+                del held[i]
+                return
+
+    def note_wait(self, cond_shim: "_SanLock") -> None:
+        """A Condition.wait is starting on ``cond_shim``'s mutex: any OTHER
+        sanitized lock this thread holds stays held for the whole wait."""
+        if not self.enabled:
+            return
+        foreign = [
+            (s, st) for s, st in self._held() if s is not cond_shim
+        ]
+        if foreign:
+            with self._mu:
+                if len(self.hold_while_blocking) < self.MAX_EDGES:
+                    self.hold_while_blocking.append({
+                        "waiting_on": cond_shim.site,
+                        "held": [s.site for s, _ in foreign],
+                        "thread": threading.current_thread().name,
+                        "stack": _stack(),
+                    })
+
+    # -- analysis -------------------------------------------------------------
+
+    def check_cycles(self) -> list[dict]:
+        """Cycles in the site-level acquisition graph. Each report carries
+        every edge of the cycle with BOTH acquisition stacks."""
+        with self._mu:
+            edges = dict(self.edges)
+        return [
+            {
+                "cycle": cyc,
+                "edges": [
+                    {"from": a, "to": b, **edges[(a, b)]}
+                    for a, b in zip(cyc, cyc[1:])
+                ],
+            }
+            for cyc in find_cycles(edges)
+        ]
+
+    def report(self) -> dict:
+        cycles = self.check_cycles()
+        with self._mu:
+            return {
+                "locks": self.n_locks,
+                "edges": len(self.edges),
+                "cycles": cycles,
+                "hold_while_blocking": list(self.hold_while_blocking),
+                "instance_hazards": list(self.instance_hazards),
+            }
+
+    def format_cycles(self, cycles: list[dict]) -> str:
+        parts = []
+        for c in cycles:
+            parts.append(
+                "potential deadlock: lock-order cycle "
+                + " -> ".join(c["cycle"])
+            )
+            for e in c["edges"]:
+                parts.append(
+                    f"  edge {e['from']} (held) -> {e['to']} (acquired) "
+                    f"on thread {e['thread']}:"
+                )
+                parts.append("    outer lock acquired at:")
+                parts.extend(f"      {ln}" for ln in e["stack_outer"][-6:])
+                parts.append("    inner lock acquired at:")
+                parts.extend(f"      {ln}" for ln in e["stack_inner"][-6:])
+        return "\n".join(parts)
+
+    def assert_clean(self) -> None:
+        cycles = self.check_cycles()
+        if cycles:
+            raise LockOrderError(
+                "threadsan: inconsistent lock acquisition order observed — "
+                "two code paths take these locks in opposite orders, which "
+                "deadlocks when their threads interleave\n"
+                + self.format_cycles(cycles)
+            )
+
+
+# -- lock shims ---------------------------------------------------------------
+
+
+class _SanLock:
+    """Instrumented Lock/RLock: delegates to the real lock, reports
+    first-depth acquisitions/releases to the sanitizer (re-entrant RLock
+    acquires don't re-edge)."""
+
+    __slots__ = ("_inner", "_san", "site", "_tls")
+
+    def __init__(self, inner, san: ThreadSanitizer, site: str):
+        self._inner = inner
+        self._san = san
+        self.site = site
+        self._tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            d = self._depth()
+            self._tls.depth = d + 1
+            if d == 0:
+                self._san.note_acquired(self)
+        return got
+
+    def release(self):
+        self._inner.release()
+        d = self._depth()
+        if d > 0:
+            self._tls.depth = d - 1
+            if d == 1:
+                self._san.note_released(self)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"<SanLock {self.site} wrapping {self._inner!r}>"
+
+    def _at_fork_reinit(self):
+        # concurrent.futures.thread touches this at MODULE level
+        # (os.register_at_fork on its shutdown lock), so a whole-process
+        # HYDRAGNN_THREADSAN=1 run importing it post-enable needs the shim
+        # to forward it; per-thread depth is meaningless in the child
+        self._inner._at_fork_reinit()
+        self._tls = threading.local()
+
+    def __getattr__(self, name):
+        # stdlib internals probe locks for implementation attributes we
+        # don't wrap; delegate rather than enumerate them
+        if name == "_inner":  # slot unset mid-__init__: no recursion
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+    # threading.Condition probes these when handed a foreign lock
+    def _is_owned(self):
+        owned = getattr(self._inner, "_is_owned", None)
+        if owned is not None:
+            return owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        # full release for RLocks (Condition.wait must drop ALL depth)
+        saver = getattr(self._inner, "_release_save", None)
+        state = saver() if saver is not None else self._inner.release()
+        d = self._depth()
+        self._tls.depth = 0
+        if d > 0:
+            self._san.note_released(self)
+        return (state, d)
+
+    def _acquire_restore(self, saved):
+        state, d = saved
+        restorer = getattr(self._inner, "_acquire_restore", None)
+        if restorer is not None:
+            restorer(state)
+        else:
+            self._inner.acquire()
+        self._tls.depth = d
+        self._san.note_acquired(self)
+
+
+class _SanCondition:
+    """Instrumented Condition: the lock half IS a :class:`_SanLock` (so
+    acquisition ordering through ``with cond:`` is tracked), the wait/notify
+    half delegates to a real Condition built over the same wrapper — the
+    stdlib implementation calls ``_release_save``/``_acquire_restore``/
+    ``_is_owned`` on it, which the shim forwards."""
+
+    def __init__(self, san: ThreadSanitizer, lock=None, site: str = "?"):
+        if isinstance(lock, _SanLock):
+            self._lockw = lock
+        elif lock is None:
+            self._lockw = _SanLock(_REAL_RLOCK(), san, site)
+        else:
+            # a foreign (unshimmed) lock object: wrap it so ordering on
+            # this condition is still visible
+            self._lockw = _SanLock(lock, san, site)
+        self._san = san
+        self.site = site
+        self._cond = _REAL_CONDITION(self._lockw)
+
+    # lock protocol — through the shim, so ordering is recorded
+    def acquire(self, *a, **kw):
+        return self._lockw.acquire(*a, **kw)
+
+    def release(self):
+        return self._lockw.release()
+
+    def __enter__(self):
+        self._lockw.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lockw.release()
+
+    # condition protocol
+    def wait(self, timeout=None):
+        self._san.note_wait(self._lockw)
+        # pass-through shim: the while-predicate contract is the CALLER's
+        # (GL103 fires at their call site, which resolves to this wrapper)
+        return self._cond.wait(timeout)  # graftlint: disable=GL103
+
+    def wait_for(self, predicate, timeout=None):
+        self._san.note_wait(self._lockw)
+        return self._cond.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1):
+        return self._cond.notify(n)
+
+    def notify_all(self):
+        return self._cond.notify_all()
+
+    notifyAll = notify_all
+
+    def _is_owned(self):
+        return self._lockw._is_owned()
+
+    def __getattr__(self, name):
+        # delegate stdlib-internal probes (waiter bookkeeping etc.) to the
+        # real Condition backing the wait/notify half
+        if name == "_cond":  # unset mid-__init__: no recursion
+            raise AttributeError(name)
+        return getattr(self._cond, name)
+
+    def __repr__(self):
+        return f"<SanCondition {self.site}>"
+
+
+# -- enable / disable ---------------------------------------------------------
+
+_active: ThreadSanitizer | None = None
+_depth = 0  # guarded-by: _patch_mu — enable() nesting count
+_patch_mu = _REAL_LOCK()
+
+
+def current() -> ThreadSanitizer | None:
+    """The active sanitizer, or None."""
+    return _active
+
+
+def enable() -> ThreadSanitizer:
+    """Start sanitizing: every lock/condition CONSTRUCTED from now until
+    the matching :func:`disable` is instrumented. Returns the collector.
+    Nested enable returns the already-active sanitizer and bumps a
+    nesting count, so an inner scope (a ``threadsan`` fixture inside an
+    ``HYDRAGNN_THREADSAN=1`` process) can't disarm the outer one."""
+    global _active, _depth
+    with _patch_mu:
+        if _active is not None:
+            _depth += 1
+            return _active
+        san = ThreadSanitizer()
+
+        def lock_factory():
+            with san._mu:
+                san.n_locks += 1
+            return _SanLock(_REAL_LOCK(), san, _site())
+
+        def rlock_factory():
+            with san._mu:
+                san.n_locks += 1
+            return _SanLock(_REAL_RLOCK(), san, _site())
+
+        def condition_factory(lock=None):
+            with san._mu:
+                san.n_locks += 1
+            return _SanCondition(san, lock, _site())
+
+        threading.Lock = lock_factory
+        threading.RLock = rlock_factory
+        threading.Condition = condition_factory
+        san.enabled = True
+        _active = san
+        _depth = 1
+        return san
+
+
+def disable() -> ThreadSanitizer | None:
+    """Undo one :func:`enable`. Only the OUTERMOST disable restores the
+    real factories and stops recording (already-created shims keep
+    working — delegation never stops); an inner disable just drops the
+    nesting count, leaving the outer scope armed. Returns the sanitizer
+    that was active (still recording if nested), for post-mortem
+    inspection, or None if none was."""
+    global _active, _depth
+    with _patch_mu:
+        san = _active
+        if san is None:
+            return None
+        _depth -= 1
+        if _depth > 0:
+            return san
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        threading.Condition = _REAL_CONDITION
+        san.enabled = False
+        _active = None
+        return san
+
+
+@contextmanager
+def instrumented():
+    """``with threadsan.instrumented() as san: ... ; san.assert_clean()``"""
+    san = enable()
+    try:
+        yield san
+    finally:
+        disable()
+
+
+def maybe_enable_from_env() -> ThreadSanitizer | None:
+    """Whole-process opt-in: ``HYDRAGNN_THREADSAN=1`` in the environment
+    enables instrumentation at ``hydragnn_tpu`` import time. The collected
+    graph is then inspectable via :func:`current` (e.g. from a debugger or
+    an atexit hook a harness installs)."""
+    from ..utils import flags
+
+    if flags.get(flags.THREADSAN):
+        return enable()
+    return None
+
+
+try:  # pytest fixture — importable from any conftest; no hard pytest dep
+    import pytest
+except ImportError:  # pragma: no cover
+    pass
+else:
+
+    @pytest.fixture
+    def threadsan():
+        """Function-scoped sanitizer: locks created inside the test are
+        instrumented; teardown asserts the acquisition graph is cycle-free.
+
+        def test_my_server(threadsan):
+            server = build_and_exercise()   # locks created here are watched
+            # teardown raises LockOrderError on any observed order cycle
+        """
+        san = enable()
+        try:
+            yield san
+        finally:
+            disable()
+        san.assert_clean()
+
+    @pytest.fixture(scope="module")
+    def threadsan_module():
+        """Module-scoped variant for suites whose servers live in
+        module-scoped fixtures (serve/fleet/elastic): enable BEFORE the
+        server fixtures construct their locks, assert once at module end."""
+        san = enable()
+        try:
+            yield san
+        finally:
+            disable()
+        san.assert_clean()
+
+
+__all__ = [
+    "LockOrderError",
+    "ThreadSanitizer",
+    "current",
+    "disable",
+    "enable",
+    "instrumented",
+    "maybe_enable_from_env",
+]

@@ -323,14 +323,14 @@ class ShardedStore:
         # the lock guards ONLY cache/telemetry bookkeeping; network
         # round-trips run outside it so concurrent fetches overlap
         self._lock = threading.Lock()
-        self._cache: OrderedDict[int, GraphSample] = OrderedDict()
+        self._cache: OrderedDict[int, GraphSample] = OrderedDict()  # guarded-by: _lock
         self._cache_size = int(cache_size)
-        self._sizes: np.ndarray | None = None  # lazy global size table
+        self._sizes: np.ndarray | None = None  # guarded-by: _sizes_lock
         self._sizes_lock = threading.Lock()
-        self._executor: ThreadPoolExecutor | None = None  # lazy, persistent
-        self.remote_fetches = 0  # telemetry: audited by tests/bench
-        self.failover_fetches = 0  # samples re-fetched from a replica
-        self.quarantine_events = 0  # peer-down transitions observed
+        self._executor: ThreadPoolExecutor | None = None  # guarded-by: _lock
+        self.remote_fetches = 0  # guarded-by: _lock (audited by tests/bench)
+        self.failover_fetches = 0  # guarded-by: _lock (replica re-fetches)
+        self.quarantine_events = 0  # guarded-by: _lock (peer-down events)
         # quarantine clock: rank -> {"until", "backoff", "failures"}; a rank
         # is quarantined while now < until AND the entry exists (the prober —
         # or a successful last-resort fetch — removes it). Shared

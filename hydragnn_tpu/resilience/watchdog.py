@@ -56,13 +56,13 @@ class Watchdog:
     def __init__(self, timeout_s: float, on_hang=None):
         self.timeout_s = float(timeout_s)
         self.on_hang = on_hang
-        self.fired = 0
-        self.events: list[str] = []
+        self.fired = 0  # guarded-by: _cond
+        self.events: list[str] = []  # guarded-by: _cond
         self._cond = threading.Condition()
         self._token = itertools.count()
-        # token -> (deadline, what, on_expire); guarded by _cond
-        self._armed: dict[int, tuple[float, str, object]] = {}
-        self._thread: threading.Thread | None = None
+        # token -> (deadline, what, on_expire)
+        self._armed: dict[int, tuple[float, str, object]] = {}  # guarded-by: _cond
+        self._thread: threading.Thread | None = None  # guarded-by: _cond
 
     @contextmanager
     def guard(self, what: str = "device sync", on_expire=None):

@@ -89,10 +89,10 @@ class RequestQueue:
         if depth < 1:
             raise ValueError(f"queue depth must be >= 1, got {depth}")
         self.depth = int(depth)
-        self._q: deque[Request] = deque()
+        self._q: deque[Request] = deque()  # guarded-by: _lock
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
-        self._closed = False
+        self._closed = False  # guarded-by: _lock
 
     def __len__(self) -> int:
         with self._lock:

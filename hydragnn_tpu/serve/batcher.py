@@ -82,7 +82,14 @@ class MicroBatcher:
     """Forms (requests, bucket) batches from a :class:`RequestQueue`.
 
     One instance per endpoint, consumed by that endpoint's dispatcher
-    thread. Policy, in order, for each batch:
+    thread. Threading contract (the GL1xx audit's note): the batcher owns
+    NO locks of its own — every shared structure it touches is the
+    queue's, reached only through ``RequestQueue``'s locked methods
+    (``get``/``push_back``), and all other state (`members`, totals, the
+    flush clock) is dispatcher-thread-local. Deadlines are
+    ``time.monotonic()`` throughout (GL105).
+
+    Policy, in order, for each batch:
 
     1. Block for the first live request (expired ones fail fast with
        :class:`DeadlineExceededError` — serving a dead request wastes the

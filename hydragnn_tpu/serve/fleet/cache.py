@@ -58,15 +58,15 @@ class AnswerCache:
     def __init__(self, budget_bytes: int):
         self.budget_bytes = int(budget_bytes)
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[str, tuple[list[np.ndarray], int]]" = (
+        self._entries: "OrderedDict[str, tuple[list[np.ndarray], int]]" = (  # guarded-by: _lock
             OrderedDict()
         )
-        self.bytes = 0
-        self.hits = 0
-        self.misses = 0
-        self.insertions = 0
-        self.evictions = 0
-        self.oversize_skips = 0
+        self.bytes = 0  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.insertions = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
+        self.oversize_skips = 0  # guarded-by: _lock
 
     def __len__(self) -> int:
         with self._lock:

@@ -110,13 +110,13 @@ class FleetRouter:
             self.cfg.quarantine_base_s, self.cfg.quarantine_cap_s
         )
         self.cache = AnswerCache(self.cfg.cache_bytes)
-        self._replicas: list[_Replica] = []
+        self._replicas: list[_Replica] = []  # guarded-by: _work
         # _work guards queues + inflight + counters; future resolution and
         # network round-trips happen OUTSIDE it (client done-callbacks run
         # inline on set_result — resolving under the lock could re-enter)
         self._work = threading.Condition(threading.Lock())
-        self._queues: dict[str, deque] = {c: deque() for c in PRIORITY_CLASSES}
-        self.counters = {
+        self._queues: dict[str, deque] = {c: deque() for c in PRIORITY_CLASSES}  # guarded-by: _work
+        self.counters = {  # guarded-by: _work
             "submitted": 0, "served": 0, "cache_hits": 0, "failed": 0,
             "cancelled": 0, "shed": 0, "shed_deadline": 0,
             "failovers": 0, "requeues": 0,
@@ -124,7 +124,7 @@ class FleetRouter:
         }
         self._running = False
         self._stopping = False
-        self._rot = 0
+        self._rot = 0  # guarded-by: _work
         self._dispatcher: threading.Thread | None = None
         self._exec: ThreadPoolExecutor | None = None
         self._probe_stop = threading.Event()
@@ -311,19 +311,62 @@ class FleetRouter:
 
     # -- dispatch -----------------------------------------------------------
 
-    def _pop_locked(self) -> "tuple[RoutedRequest | None, list]":
-        """Strict-priority pop + the expired requests swept past on the
-        way (rejected OUTSIDE the lock by the caller)."""
-        expired = []
+    def _pop_dispatchable_locked(
+        self,
+    ) -> "tuple[RoutedRequest | None, _Replica | None, list]":
+        """Strict-priority pop of the oldest request whose model has a free
+        replica slot — the slot is RESERVED (inflight++) under the same
+        lock hold — plus the expired requests swept past on the way
+        (rejected OUTSIDE the lock by the caller).
+
+        A request whose model has no free slot STAYS QUEUED. The previous
+        dispatcher popped first and parked on the slot wait holding the
+        request, which (a) made class-budget accounting lie by one — a
+        popped-but-undispatched request no longer counted against its
+        class, so the class over-admitted past its budget — and (b)
+        inverted priority: a popped best_effort parked on the slot wait
+        beat any interactive request that arrived while it waited. Popping
+        and reserving atomically makes both properties hold by
+        construction instead of by timing luck."""
+        expired: list = []
+        # models probed slotless THIS scan: nothing can free a slot while
+        # we hold _work, so N queued requests of one saturated model cost
+        # one _pick_locked probe, not N (and the deque is walked by
+        # iteration + one rebuild, never by O(n) index/delete)
+        no_slot: set[str] = set()
         for cls in PRIORITY_CLASSES:
             q = self._queues[cls]
-            while q:
-                req = q.popleft()
+            if not q:
+                continue
+            chosen: "tuple[RoutedRequest, _Replica] | None" = None
+            kept: list = []
+            for req in q:
+                if chosen is not None:
+                    kept.append(req)
+                    continue
                 if req.expired():
                     expired.append(req)
                     continue
-                return req, expired
-        return None, expired
+                if req.model in no_slot:
+                    # no slot for THIS model: later requests of another
+                    # model may still dispatch (strict priority, no
+                    # cross-model head-of-line blocking); FIFO within
+                    # (class, model) holds
+                    kept.append(req)
+                    continue
+                target = self._pick_locked(req.model)
+                if target is None:
+                    no_slot.add(req.model)
+                    kept.append(req)
+                    continue
+                target.inflight += 1
+                chosen = (req, target)
+            if len(kept) != len(q):
+                q.clear()
+                q.extend(kept)
+            if chosen is not None:
+                return chosen[0], chosen[1], expired
+        return None, None, expired
 
     def _shed_expired(self, expired: list) -> None:
         for req in expired:
@@ -336,9 +379,15 @@ class FleetRouter:
                 self._count("cancelled")
 
     def _pick_locked(self, model: str) -> "_Replica | None":
-        """Least-loaded healthy replica advertising ``model`` with a free
-        in-flight slot; quarantined replicas only as a last resort (the
-        store's healthy-first discipline). Ties rotate."""
+        """Least-loaded HEALTHY replica advertising ``model`` with a free
+        in-flight slot; ties rotate. Quarantined replicas are a last
+        resort only when the model has NO healthy replica at all — a
+        healthy sibling that is merely slot-saturated means WAIT for its
+        slot (return None), not "burn one of the request's bounded
+        failover attempts on a peer we already know is down": under a
+        replica kill the survivor's window saturates instantly, and the
+        old free-slots-beat-health order hammered every queued request
+        into the dead peer until its attempt cap killed it."""
         avail = [
             r for r in self._replicas
             if model in r.models and r.inflight < self.cfg.inflight_per_replica
@@ -348,10 +397,15 @@ class FleetRouter:
         order = self._health.order([r.rank for r in avail], rot=self._rot)
         self._rot += 1
         by_rank = {r.rank: r for r in avail}
-        healthy = [
-            by_rank[k] for k in order if not self._health.quarantined(k)
-        ]
-        pool = healthy or [by_rank[order[0]]]
+        pool = [by_rank[k] for k in order if not self._health.quarantined(k)]
+        if not pool:
+            if any(
+                model in r.models and not self._health.quarantined(r.rank)
+                for r in self._replicas
+            ):
+                return None  # healthy-but-saturated exists: wait for it
+            pool = [by_rank[order[0]]]  # all quarantined: a request is
+            # the cheapest live probe — try the soonest-due peer
         best = pool[0]
         for r in pool[1:]:
             if r.inflight < best.inflight:
@@ -361,42 +415,22 @@ class FleetRouter:
     def _dispatch_loop(self) -> None:
         while True:
             with self._work:
-                req, expired = self._pop_locked()
+                if self._stopping:
+                    return  # stop() drains whatever is still queued
+                req, target, expired = self._pop_dispatchable_locked()
                 if req is None and not expired:
-                    if self._stopping:
-                        return
+                    # every state change notifies (submit, slot free in
+                    # _serve_one's finally, requeue, attach, stop); the
+                    # timeout is NOT the wakeup mechanism — it only bounds
+                    # the deadline-expiry sweep on an otherwise idle router
                     self._work.wait(0.1)
                     continue
             self._shed_expired(expired)
             if req is None:
                 continue
-            target = None
-            while target is None:
-                with self._work:
-                    if self._stopping:
-                        # stop() drains the queues; park the request back
-                        self._queues[req.priority].appendleft(req)
-                        return
-                    target = self._pick_locked(req.model)
-                    if target is not None:
-                        target.inflight += 1
-                    else:
-                        self._work.wait(0.05)
-            if req.expired():
-                # dispatch-time re-check: the slot wait can outlive the
-                # deadline — serving it anyway would return a "success"
-                # past its contract (mirrors the in-process batcher)
-                with self._work:
-                    target.inflight -= 1
-                    self._work.notify_all()
-                if req.reject(DeadlineExceededError(
-                    "deadline passed while waiting for a replica slot"
-                )):
-                    self._count("shed_deadline")
-                    self._count("shed")
-                else:
-                    self._count("cancelled")
-                continue
+            # the pop already re-checked expiry at dequeue and reserved the
+            # slot under the same lock hold — nothing can age between here
+            # and the executor handoff but microseconds
             self._exec.submit(self._serve_one, req, target)
 
     # -- replica round-trip -------------------------------------------------
@@ -422,7 +456,27 @@ class FleetRouter:
                 self._mark_replica_down(replica, e)
                 self._requeue(req, e)
                 return
-            self._resolve(req, replica, z)
+            try:
+                self._resolve(req, replica, z)
+            except Exception as e:
+                # a malformed reply (missing fields, bad shapes) must fail
+                # THIS request loudly, never leave its claimed future
+                # unresolved — an unhandled raise here would hang the
+                # client until its own timeout with zero diagnostics
+                exc = RuntimeError(
+                    f"replica {replica.rank} answered an undecodable "
+                    f"predict reply ({type(e).__name__}: {e})"
+                )
+                try:
+                    claimed = req.claim()
+                except RuntimeError:
+                    claimed = True  # _resolve claimed it before raising
+                if claimed:
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+                    self._count("failed")
+                else:
+                    self._count("cancelled")
         finally:
             with self._work:
                 replica.inflight -= 1

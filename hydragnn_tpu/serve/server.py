@@ -223,7 +223,7 @@ class ModelEndpoint:
         self.calib_samples = list(calib_samples) if calib_samples else [example]
         self.thread: threading.Thread | None = None
         self._lock = threading.Lock()
-        self.counters = {
+        self.counters = {  # guarded-by: _lock
             "submitted": 0, "served": 0, "shed": 0, "shed_deadline": 0,
             "shed_oversize": 0, "failed": 0, "cancelled": 0,
             "batches": 0, "real_graph_slots": 0, "graph_slots": 0,

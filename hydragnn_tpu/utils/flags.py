@@ -251,6 +251,16 @@ COMPILE_SENTINEL = _register(Flag(
     "(analysis/sentinel.py): 'warn' prints the per-epoch compile delta "
     "after the warm-up epoch, 'strict' raises RecompileError; unset/0 "
     "disables."))
+THREADSAN = _register(Flag(
+    "HYDRAGNN_THREADSAN", "bool", False,
+    "Runtime lock-order sanitizer (analysis/threadsan.py): instrument "
+    "every threading.Lock/RLock/Condition the process constructs after "
+    "hydragnn_tpu import, record the per-thread lock acquisition-order "
+    "graph plus hold-while-blocking events, and expose cycle detection "
+    "(potential deadlocks, reported with BOTH acquisition stacks). Tests "
+    "use the `threadsan` pytest fixture instead; this flag arms whole "
+    "process runs (chaos drills, soak tests). Small per-acquire overhead "
+    "— diagnostics, not production serving."))
 
 # -- config / observability -------------------------------------------------
 USE_VARIABLE_GRAPH_SIZE = _register(Flag(

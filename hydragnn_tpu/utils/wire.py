@@ -407,11 +407,12 @@ class WireServer:
 
         self._name = name or type(self).__name__
         self._test_delay_s = float(_test_delay_s)
-        self._conns: set[socket.socket] = set()  # live handler sockets
+        # live handler sockets
+        self._conns: set[socket.socket] = set()  # guarded-by: _conns_lock
         self._conns_lock = threading.Lock()
         self._srv = Server((host, int(port)), Handler)
         self.port = self._srv.server_address[1]
-        self.closed = False
+        self.closed = False  # guarded-by: _conns_lock
 
         def _serve() -> None:
             try:
@@ -477,10 +478,10 @@ class ConnPool:
     capped; excess ones close on release."""
 
     def __init__(self, max_idle_per_peer: int = 4, timeout: float = 120.0):
-        self._idle: dict[object, list[socket.socket]] = {}
+        self._idle: dict[object, list[socket.socket]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._max_idle = int(max_idle_per_peer)
-        self._closed = False
+        self._closed = False  # guarded-by: _lock
         self.timeout = float(timeout)  # connect AND per-recv deadline
 
     def acquire(self, key, host: str, port: int) -> tuple[socket.socket, bool]:
@@ -704,7 +705,7 @@ class HealthTable:
         self.lock = threading.Lock()
         # key -> {"until", "backoff", "failures"}; quarantined while
         # now < until AND the entry exists
-        self.entries: dict = {}
+        self.entries: dict = {}  # guarded-by: lock
 
     def quarantined(self, key) -> bool:
         with self.lock:

@@ -58,3 +58,12 @@ def random_molecule_samples(n, seed=0, lo=9, hi=30):
 # `def test_x(compile_sentinel): ... with compile_sentinel(max_compiles=0): ...`
 # to assert jit compile-count stability over a region.
 from hydragnn_tpu.analysis.sentinel import compile_sentinel  # noqa: E402,F401
+
+# Lock-order sanitizer fixtures (hydragnn_tpu.analysis.threadsan): `threadsan`
+# instruments locks created inside one test and asserts the acquisition graph
+# is cycle-free at teardown; `threadsan_module` is the module-scoped variant
+# the serve/fleet/elastic suites ride (their servers live in module fixtures).
+from hydragnn_tpu.analysis.threadsan import (  # noqa: E402,F401
+    threadsan,
+    threadsan_module,
+)

@@ -1,0 +1,23 @@
+"""GL101 fixture: guarded attributes written without their documented lock."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self._by_key = {}  # guarded-by: _lock
+        self._stats = {}  # guarded-by: _missing_lock  # EXPECT:GL101
+
+    def add(self, x):
+        self._items.append(x)  # EXPECT:GL101
+        self._count += 1  # EXPECT:GL101
+
+    def index(self, key, x):
+        self._by_key[key] = x  # EXPECT:GL101
+
+    def add_safe(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._count += 1

@@ -1,0 +1,35 @@
+"""GL101 clean twin: every guarded write holds the lock (directly, through
+the paired Condition, or inside a *_locked caller-holds helper)."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._items = []  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._count += 1
+
+    def add_via_condition(self, x):
+        # acquiring the Condition acquires the same mutex the data is
+        # guarded by — the alias is understood
+        with self._nonempty:
+            self._items.append(x)
+            self._nonempty.notify()
+
+    def pop_locked(self):
+        # *_locked: the caller holds self._lock by contract
+        self._count -= 1
+        return self._items.pop()
+
+    def drain(self):
+        with self._lock:
+            out = list(self._items)
+            self._items.clear()
+            self._count = 0
+        return out

@@ -1,0 +1,21 @@
+"""GL102 fixture: two methods acquire the same two locks in opposite
+orders — the classic AB/BA deadlock."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.balance = 0
+        self.log = []
+
+    def debit(self):
+        with self._accounts:
+            with self._audit:  # EXPECT:GL102
+                self.log.append(self.balance)
+
+    def reconcile(self):
+        with self._audit:
+            with self._accounts:
+                self.balance += 1

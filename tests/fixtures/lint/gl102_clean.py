@@ -1,0 +1,26 @@
+"""GL102 clean twin: one global acquisition order, everywhere."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.balance = 0
+        self.log = []
+
+    def debit(self):
+        with self._accounts:
+            with self._audit:
+                self.log.append(self.balance)
+
+    def reconcile(self):
+        # same order as debit: accounts BEFORE audit
+        with self._accounts:
+            with self._audit:
+                self.balance += 1
+
+    def audit_only(self):
+        # taking a single lock is order-neutral
+        with self._audit:
+            return list(self.log)

@@ -1,0 +1,21 @@
+"""GL103 fixture: Condition.wait guarded by `if` instead of `while` (lost
+predicate re-check) and a wait_for whose timeout result is discarded."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self.mail = []
+
+    def take_if(self):
+        with self._ready:
+            if not self.mail:
+                self._ready.wait()  # EXPECT:GL103
+            return self.mail.pop()
+
+    def take_blind(self, timeout):
+        with self._ready:
+            self._ready.wait_for(lambda: bool(self.mail), timeout)  # EXPECT:GL103
+            return self.mail.pop()

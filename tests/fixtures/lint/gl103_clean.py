@@ -1,0 +1,28 @@
+"""GL103 clean twin: wait under a while-predicate; wait_for result used."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self.mail = []
+
+    def take(self):
+        with self._ready:
+            while not self.mail:
+                self._ready.wait()
+            return self.mail.pop()
+
+    def take_timed(self, timeout):
+        with self._ready:
+            while not self.mail:
+                if not self._ready.wait(timeout):
+                    return None
+            return self.mail.pop()
+
+    def take_for(self, timeout):
+        with self._ready:
+            if not self._ready.wait_for(lambda: bool(self.mail), timeout):
+                return None  # timeout with predicate unmet: handled
+            return self.mail.pop()

@@ -1,0 +1,35 @@
+"""GL104 fixture: blocking calls inside critical sections."""
+import subprocess
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._io = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.data = b""
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(0.5)  # EXPECT:GL104
+
+    def read(self, sock):
+        with self._lock:
+            self.data = sock.recv(4096)  # EXPECT:GL104
+        return self.data
+
+    def shell(self):
+        with self._lock:
+            subprocess.run(["true"])  # EXPECT:GL104
+
+    def harvest(self, fut):
+        with self._lock:
+            return fut.result()  # EXPECT:GL104
+
+    def wait_holding_foreign(self):
+        with self._io:
+            with self._cond:
+                while not self.data:
+                    self._cond.wait(0.1)  # EXPECT:GL104

@@ -1,0 +1,38 @@
+"""GL104 clean twin: copy under the lock, block outside it."""
+import subprocess
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.data = b""
+        self.pending = []
+
+    def backoff(self):
+        time.sleep(0.5)  # no lock held: fine
+        with self._lock:
+            self.pending.clear()
+
+    def read(self, sock):
+        payload = sock.recv(4096)  # network wait outside the lock
+        with self._lock:
+            self.data = payload
+        return payload
+
+    def shell(self):
+        with self._lock:
+            argv = list(self.pending)  # snapshot under the lock
+        subprocess.run(argv or ["true"])  # block outside it
+
+    def harvest(self, fut):
+        result = fut.result()  # wait first ...
+        with self._lock:
+            self.pending.append(result)  # ... bookkeep after
+
+    def wait_own_lock_only(self):
+        with self._cond:
+            while not self.data:
+                self._cond.wait(0.1)  # releases its OWN mutex: fine

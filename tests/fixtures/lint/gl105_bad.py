@@ -1,0 +1,15 @@
+"""GL105 fixture: wall-clock time in deadline/timeout arithmetic."""
+import time
+
+
+def arm(timeout_s):
+    deadline = time.time() + timeout_s  # EXPECT:GL105
+    return deadline
+
+
+def expired(deadline):
+    return time.time() >= deadline  # EXPECT:GL105
+
+
+def remaining(deadline):
+    return deadline - time.time()  # EXPECT:GL105

@@ -1,0 +1,18 @@
+"""GL105 clean twin: monotonic deadlines; wall-clock only for timestamps."""
+import time
+
+
+def arm(timeout_s):
+    deadline = time.monotonic() + timeout_s
+    return deadline
+
+
+def expired(deadline):
+    return time.monotonic() >= deadline
+
+
+def stamp_row(row):
+    # wall-clock as DATA (a log timestamp) is fine — only deadline
+    # arithmetic needs the monotonic clock
+    row["created"] = time.time()
+    return row

@@ -1,0 +1,13 @@
+"""GL106 fixture: threads with no ownership story — neither daemon=True
+(with a stop flag) nor a kept-and-joined handle."""
+import threading
+
+
+def spawn(fn):
+    t = threading.Thread(target=fn)  # EXPECT:GL106
+    t.start()
+    return t
+
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn).start()  # EXPECT:GL106

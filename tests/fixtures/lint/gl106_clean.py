@@ -1,0 +1,22 @@
+"""GL106 clean twin: every thread has declared ownership — daemon with a
+stop flag, or a handle that is joined."""
+import threading
+
+
+class Worker:
+    def __init__(self, fn):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=fn, name="worker", daemon=True
+        )
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+
+
+def run_to_completion(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=30.0)
+    return t

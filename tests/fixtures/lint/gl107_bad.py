@@ -1,0 +1,27 @@
+"""GL107 fixture: guarded mutable state escaping by reference — the
+generalized ShardedStore cache-aliasing bug (ADVICE r5)."""
+import threading
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}  # guarded-by: _lock
+        self._order = []  # guarded-by: _lock
+
+    def put(self, k, v):
+        with self._lock:
+            self._rows[k] = v
+            self._order.append(k)
+
+    def snapshot(self):
+        with self._lock:
+            return self._rows  # EXPECT:GL107
+
+    def row(self, k):
+        with self._lock:
+            return self._rows[k]  # EXPECT:GL107
+
+    def order(self):
+        out = self._order
+        return out  # EXPECT:GL107

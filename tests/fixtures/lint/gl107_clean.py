@@ -1,0 +1,27 @@
+"""GL107 clean twin: guarded state leaves the lock only as a copy."""
+import copy
+import threading
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}  # guarded-by: _lock
+        self._order = []  # guarded-by: _lock
+
+    def put(self, k, v):
+        with self._lock:
+            self._rows[k] = v
+            self._order.append(k)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._rows)
+
+    def row(self, k):
+        with self._lock:
+            return copy.deepcopy(self._rows[k])
+
+    def order(self):
+        with self._lock:
+            return list(self._order)

@@ -33,6 +33,10 @@ from hydragnn_tpu.analysis.sentinel import (
 REPO = Path(__file__).resolve().parent.parent
 FIXTURES = Path(__file__).resolve().parent / "fixtures" / "lint"
 RULE_IDS = ["GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"]
+# the GL1xx concurrency family (rules_concurrency.py) rides the same
+# corpus machinery: glXXX_bad.py with EXPECT tags + a clean twin that must
+# stay silent under the FULL rule set
+RULE_IDS += ["GL101", "GL102", "GL103", "GL104", "GL105", "GL106", "GL107"]
 
 _EXPECT = re.compile(r"EXPECT:(GL\d{3})")
 
@@ -112,6 +116,34 @@ def test_jit_reachability_through_package_init_relative_import(tmp_path):
     assert [(f.rule, f.path, f.line) for f in findings] == [
         ("GL001", "pkg/helpers.py", 2)
     ]
+
+
+def test_jit_reachability_extends_to_aot_and_pallas(tmp_path):
+    """Symbol-resolution extension for the modules added since PR 1: a
+    function handed to ``utils.compile_cache.aot_compile`` (the serving
+    AOT path) or ``pallas_call`` is jit-traced, so a host sync inside it
+    must be a GL001 finding — while aot_compile/pallas_call inside warm-up
+    loops stay exempt from GL003 (one compile per bucket is the sanctioned
+    pattern, not a retrace bug)."""
+    p = tmp_path / "aotmod.py"
+    p.write_text(
+        "from hydragnn_tpu.utils.compile_cache import aot_compile\n"
+        "from jax.experimental import pallas as pl\n\n\n"
+        "def predict(state, batch):\n"
+        "    return float(batch)\n\n\n"
+        "def kernel(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...].item()\n\n\n"
+        "def warm(buckets, structs):\n"
+        "    table = {}\n"
+        "    for b in buckets:\n"
+        "        table[b] = aot_compile(predict, None, structs[b])\n"
+        "    return table, pl.pallas_call(kernel, out_shape=None)\n"
+    )
+    findings = analyze([str(p)])
+    assert {(f.rule, f.line) for f in findings} == {
+        ("GL001", 6),   # float() on the traced batch inside predict
+        ("GL001", 10),  # .item() inside the pallas kernel
+    }, [f.format() for f in findings]
 
 
 def test_unknown_rule_id_rejected():
@@ -282,6 +314,55 @@ def test_injected_violation_fails_the_cli():
     )
     assert proc.returncode == 1
     assert "GL001" in proc.stdout
+
+
+def test_injected_concurrency_violation_fails_the_cli():
+    """The GL1xx family is part of the same tier-1 gate: an unguarded
+    write slipped into the scan set must fail --fail-on-new."""
+    proc = _run_cli(
+        "hydragnn_tpu", str(FIXTURES / "gl101_bad.py"), "--fail-on-new"
+    )
+    assert proc.returncode == 1
+    assert "GL101" in proc.stdout
+
+
+def test_format_json_mode_for_machine_consumption():
+    """--format=json emits {summary, new, baselined}; summary.fail mirrors
+    the exit code and new_by_rule gives CI annotators per-rule counts."""
+    proc = _run_cli(str(FIXTURES / "gl101_bad.py"), "--format=json")
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout)
+    assert out["summary"]["fail"] is True
+    assert out["summary"]["new"] == len(out["new"]) > 0
+    assert out["summary"]["new_by_rule"].get("GL101", 0) >= 3
+    f = out["new"][0]
+    assert {"rule", "path", "line", "col", "message", "snippet"} <= set(f)
+    # clean input: fail=false, exit 0, empty lists — and --json stays an
+    # alias of the same shape
+    proc = _run_cli(str(FIXTURES / "gl101_clean.py"), "--json")
+    assert proc.returncode == 0
+    out = json.loads(proc.stdout)
+    assert out["summary"] == {
+        "new": 0, "baselined": 0, "new_by_rule": {}, "fail": False,
+    }
+
+
+def test_guarded_by_annotations_present_in_threaded_modules():
+    """The GL101/GL107 contract only bites where the convention is applied:
+    every threaded module of the serving/data plane must carry at least one
+    `# guarded-by:` annotation, so a refactor that drops them (silently
+    disabling the rules there) is caught."""
+    for rel in (
+        "hydragnn_tpu/serve/admission.py",
+        "hydragnn_tpu/serve/server.py",
+        "hydragnn_tpu/serve/fleet/router.py",
+        "hydragnn_tpu/serve/fleet/cache.py",
+        "hydragnn_tpu/utils/wire.py",
+        "hydragnn_tpu/datasets/sharded.py",
+        "hydragnn_tpu/resilience/watchdog.py",
+    ):
+        text = (REPO / rel).read_text()
+        assert "# guarded-by:" in text, f"{rel} lost its guarded-by annotations"
 
 
 def test_ruff_clean_when_available():

@@ -54,6 +54,15 @@ from hydragnn_tpu.train.loop import train_epoch, train_validate_test
 from test_config import CI_CONFIG
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _threadsan(threadsan_module):
+    """ShardedStore / ShardServer / watchdog / prober locks run under the
+    lock-order sanitizer for the whole module; teardown asserts the
+    acquisition graph is cycle-free — the failover chaos here doubles as a
+    deadlock drill."""
+    yield threadsan_module
+
+
 @pytest.fixture()
 def in_tmp(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)

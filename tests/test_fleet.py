@@ -57,6 +57,15 @@ from hydragnn_tpu.utils import wire
 from test_config import CI_CONFIG
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _threadsan(threadsan_module):
+    """Router + replica + wire + cache locks all run under the lock-order
+    sanitizer for the whole module; teardown asserts cycle-free (the fleet
+    suite's chaos scenarios — kills, dribblers, overload — double as
+    deadlock drills)."""
+    yield threadsan_module
+
+
 @pytest.fixture(scope="module")
 def warm_server():
     """ONE warm single-model PredictionServer shared by every non-slow
@@ -227,6 +236,88 @@ def test_per_class_shedding_order_and_deadline_shed(warm_server):
         assert st["shed"] >= 2
     finally:
         host.set_delay(0.0)
+        router.stop()
+        host.close()
+
+
+def test_dispatcher_no_priority_inversion_on_slot_wait(warm_server):
+    """Regression (GL1xx audit): the dispatcher used to POP a request
+    before a replica slot was free and park on the slot wait holding it —
+    so a popped best_effort beat any interactive request that arrived
+    while it waited, and the popped request stopped counting against its
+    class budget. Now pop+slot-reserve are atomic: with the single slot
+    stalled, an interactive submitted AFTER a queued best_effort must
+    still dispatch FIRST when the slot frees."""
+    server, samples = warm_server["server"], warm_server["samples"]
+    host = ReplicaHost(server)
+    router = _router(host, inflight_per_replica=1, cache_bytes=0)
+    try:
+        host.set_delay(0.25)
+        f_batch = router.submit("gin", samples[0], priority="batch")
+        time.sleep(0.05)  # the slot is now held by the stalled batch req
+        f_be = router.submit("gin", samples[1], priority="best_effort")
+        time.sleep(0.05)  # old dispatcher would have popped f_be by now
+        f_int = router.submit("gin", samples[2], priority="interactive")
+        assert f_int.result(timeout=10)["heads"]
+        # the interactive answer landed while best_effort is still in
+        # flight (its 0.25 s round-trip started strictly after)
+        assert not f_be.done()
+        host.set_delay(0.0)
+        assert f_be.result(timeout=10)["heads"]
+        assert f_batch.result(timeout=10)["heads"]
+    finally:
+        host.set_delay(0.0)
+        router.stop()
+        host.close()
+
+
+def test_pick_waits_for_saturated_healthy_replica_not_dead_one():
+    """Regression (GL1xx audit): with the healthy survivor's in-flight
+    window momentarily full, ``_pick_locked`` used to fall back to the
+    QUARANTINED replica (whose slots are all free because it is dead),
+    burning the request's bounded failover attempts on a known-dead peer.
+    A healthy-but-saturated replica now means WAIT (None); the quarantined
+    peer is only a last resort when NO healthy replica serves the model."""
+    from hydragnn_tpu.serve.fleet.router import _Replica
+
+    router = FleetRouter({"inflight_per_replica": 2})
+    router._replicas = [
+        _Replica(rank=0, host="h0", port=1, models=("gin",), quantized={}),
+        _Replica(rank=1, host="h1", port=2, models=("gin",), quantized={}),
+    ]
+    router._health.bump(0)  # rank 0 is quarantined (dead)
+    router._replicas[1].inflight = 2  # rank 1 healthy but saturated
+    with router._work:
+        assert router._pick_locked("gin") is None  # wait, don't hammer 0
+        router._replicas[1].inflight = 1
+        assert router._pick_locked("gin").rank == 1  # healthy + free slot
+        router._health.bump(1)  # now EVERYTHING is quarantined
+        assert router._pick_locked("gin").rank in (0, 1)  # last resort
+
+
+def test_undecodable_replica_reply_fails_fast_not_hang(warm_server):
+    """Regression (GL1xx audit): an exception while decoding a replica's
+    predict reply (missing fields) escaped ``_serve_one`` and left the
+    request's future unresolved — the client hung until its own timeout
+    with zero diagnostics. It must instead reject promptly and typed."""
+    server, samples = warm_server["server"], warm_server["samples"]
+    host = ReplicaHost(server)
+    router = _router(host, cache_bytes=0)
+    try:
+        real = router._rt.round_trip
+
+        def garbled(*args, **kwargs):
+            if "predict" in kwargs:
+                return {"garbage": np.asarray(1, np.int64)}  # no "n" field
+            return real(*args, **kwargs)
+
+        router._rt.round_trip = garbled
+        fut = router.submit("gin", samples[0])
+        with pytest.raises(RuntimeError, match="undecodable"):
+            fut.result(timeout=10)
+        assert router.stats()["failed"] == 1
+    finally:
+        router._rt.round_trip = real
         router.stop()
         host.close()
 

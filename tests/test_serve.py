@@ -42,6 +42,15 @@ from hydragnn_tpu.train.step import create_train_state, make_predict_step
 from test_config import CI_CONFIG
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _threadsan(threadsan_module):
+    """Every lock the serving tier creates in this module (queues, endpoint
+    counters, batcher conditions, dispatcher plumbing) runs under the
+    lock-order sanitizer; module teardown asserts the observed acquisition
+    graph is cycle-free — the serve suite doubles as a deadlock drill."""
+    yield threadsan_module
+
+
 def _multihead_config():
     """CI config with a graph head + a node head (covers both gather paths)."""
     cfg = copy.deepcopy(CI_CONFIG)

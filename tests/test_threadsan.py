@@ -1,0 +1,259 @@
+"""Lock-order sanitizer (hydragnn_tpu.analysis.threadsan) gates.
+
+The acceptance pair for ISSUE 13's runtime half: a SEEDED two-lock
+deadlock (AB in one thread, BA in another, run sequentially so the test
+itself can never actually deadlock) must be detected with BOTH
+acquisition stacks named, while consistent-order nesting, re-entrant
+RLocks, stdlib futures/executors/events and the repo's own Condition
+idioms must stay clean under instrumentation.
+"""
+
+import threading
+
+import pytest
+
+from hydragnn_tpu.analysis import threadsan as ts
+
+
+@pytest.fixture(autouse=True)
+def _restore_factories():
+    """Never leak extra sanitizer nesting into other tests, even when a
+    test body raises mid-enable — but unwind only the levels THIS test
+    added: under `HYDRAGNN_THREADSAN=1 pytest` the process-wide outermost
+    level must survive (the nesting guarantee these tests document)."""
+    base = ts._depth
+    yield
+    while ts._depth > base:
+        ts.disable()
+    if base == 0:
+        assert threading.Lock is ts._REAL_LOCK
+        assert threading.Condition is ts._REAL_CONDITION
+
+
+def test_seeded_two_lock_deadlock_detected_with_both_stacks():
+    """THE acceptance fixture: opposite-order acquisition across two
+    threads is reported as a cycle naming both code paths."""
+    san = ts.enable()
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ab_path():
+        with a:
+            with b:
+                pass
+
+    def ba_path():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab_path, name="ab")
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=ba_path, name="ba")
+    t2.start()
+    t2.join()
+    ts.disable()
+
+    cycles = san.check_cycles()
+    assert len(cycles) == 1
+    with pytest.raises(ts.LockOrderError) as ei:
+        san.assert_clean()
+    msg = str(ei.value)
+    assert "lock-order cycle" in msg
+    # BOTH acquisition stacks are in the report, one per conflicting edge,
+    # each naming the function that took the locks in that order
+    assert msg.count("outer lock acquired at") == 2
+    assert msg.count("inner lock acquired at") == 2
+    assert "ab_path" in msg and "ba_path" in msg
+    # and the threads are attributed
+    assert "ab" in msg and "ba" in msg
+
+
+def test_consistent_order_and_reentrant_rlock_stay_clean():
+    san = ts.enable()
+    a = threading.Lock()
+    b = threading.Lock()
+    r = threading.RLock()
+
+    def worker():
+        with a:
+            with b:  # same order everywhere: no cycle
+                pass
+        with r:
+            with r:  # re-entrant: no self-edge
+                pass
+
+    for _ in range(3):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    ts.disable()
+    assert san.check_cycles() == []
+    san.assert_clean()  # no raise
+
+
+def test_condition_wait_releases_own_mutex_but_not_foreign():
+    """A Condition.wait on its own lock is clean; waiting while a FOREIGN
+    sanitized lock is held is recorded as a hold-while-blocking event."""
+    san = ts.enable()
+    outer = threading.Lock()
+    cond = threading.Condition()
+
+    def own_only():
+        with cond:
+            cond.wait(timeout=0.01)
+
+    def with_foreign():
+        with outer:
+            with cond:
+                cond.wait(timeout=0.01)
+
+    t = threading.Thread(target=own_only)
+    t.start()
+    t.join()
+    assert san.hold_while_blocking == []
+    t = threading.Thread(target=with_foreign)
+    t.start()
+    t.join()
+    ts.disable()
+    assert len(san.hold_while_blocking) == 1
+    ev = san.hold_while_blocking[0]
+    assert ev["held"] and ev["stack"]
+    san.assert_clean()  # hold-while-blocking is data, not a cycle
+
+
+def test_condition_wait_notify_roundtrip_under_instrumentation():
+    """The repo's core idiom (bounded queue: Condition(self._lock),
+    while-predicate wait, producer notify) must WORK — not just be
+    watched — through the shims."""
+    san = ts.enable()
+    lock = threading.Lock()
+    cond = threading.Condition(lock)
+    items = []
+    got = []
+
+    def consumer():
+        with cond:
+            while not items:
+                cond.wait(timeout=5.0)
+            got.append(items.pop())
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    with cond:
+        items.append(42)
+        cond.notify()
+    t.join(timeout=5.0)
+    ts.disable()
+    assert got == [42]
+    san.assert_clean()
+
+
+def test_stdlib_futures_executor_event_compat():
+    """Locks constructed by concurrent.futures / Event while instrumented
+    (thousands per serving test) must behave identically and stay clean."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    san = ts.enable()
+    ev = threading.Event()
+    with ThreadPoolExecutor(2) as ex:
+        fut = ex.submit(lambda: (ev.set(), 7)[1])
+        assert fut.result(timeout=5) == 7
+    assert ev.wait(timeout=5)
+    ts.disable()
+    san.assert_clean()
+    assert san.n_locks > 0  # the machinery WAS being watched
+
+
+def test_same_site_instances_are_hazard_data_not_failure():
+    """Two instances from ONE creation site acquired nested (two queues of
+    one class) is an instance-order hazard — surfaced as data, but not an
+    assert_clean failure (without a global instance order it is suspicion,
+    not proof)."""
+    san = ts.enable()
+    pair = [threading.Lock() for _ in range(2)]  # one creation site
+    for _ in range(5):  # a hot path re-nesting must not grow the list
+        with pair[0]:
+            with pair[1]:
+                pass
+    ts.disable()
+    assert san.check_cycles() == []
+    assert len(san.instance_hazards) == 1  # first observation per site
+    san.assert_clean()
+
+
+def test_enable_is_nesting_counted_and_final_disable_restores():
+    """A nested enable/disable pair (a `threadsan` fixture inside an
+    HYDRAGNN_THREADSAN=1 process) must NOT disarm the outer scope — only
+    the outermost disable restores the real factories."""
+    san1 = ts.enable()
+    san2 = ts.enable()
+    assert san1 is san2 and ts.current() is san1
+    ts.disable()  # inner: outer scope stays armed and recording
+    assert ts.current() is san1 and san1.enabled
+    assert threading.Lock is not ts._REAL_LOCK
+    ts.disable()  # outermost: full restore
+    assert ts.current() is None
+    assert threading.Lock is ts._REAL_LOCK
+    assert threading.RLock is ts._REAL_RLOCK
+    assert threading.Condition is ts._REAL_CONDITION
+
+
+def test_shims_keep_working_after_disable():
+    """A daemon thread still holding a shim after disable() must keep
+    functioning (delegation never stops) — it just records nothing."""
+    san = ts.enable()
+    lk = threading.Lock()
+    ts.disable()
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+    assert san.enabled is False
+
+
+def test_fresh_stdlib_import_under_instrumentation():
+    """Regression (verify drive): concurrent.futures.thread touches
+    ``_global_shutdown_lock._at_fork_reinit`` at MODULE level, so a
+    whole-process HYDRAGNN_THREADSAN=1 run that imports it AFTER enable()
+    (the arming happens at hydragnn_tpu import, before most stdlib lazy
+    imports) used to crash with AttributeError on the shim. The shims now
+    forward unknown attributes to the real lock."""
+    import subprocess
+    import sys
+
+    code = (
+        "from hydragnn_tpu.analysis import threadsan\n"
+        "import sys\n"
+        "for m in list(sys.modules):\n"
+        "    if m.startswith('concurrent.futures'):\n"
+        "        del sys.modules[m]\n"
+        "threadsan.enable()\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "with ThreadPoolExecutor(1) as ex:\n"
+        "    assert ex.submit(lambda: 7).result(timeout=10) == 7\n"
+        "threadsan.disable()\n"
+        "print('OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_threadsan_fixture_passes_on_clean_code(threadsan):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    assert threadsan is ts.current()
+
+
+def test_threadsan_flag_registered():
+    from hydragnn_tpu.utils import flags
+
+    assert flags.THREADSAN.name == "HYDRAGNN_THREADSAN"
+    assert flags.THREADSAN.kind == "bool"
