@@ -607,6 +607,22 @@ class GraphLoader:
         if self._resume_skip:
             # mid-epoch resume: drop the already-trained prefix (post-reorder
             # order — what the interrupted run actually consumed), one-shot
+            if self._resume_skip >= len(plan):
+                # resume point AT (or past) the epoch boundary: every batch
+                # of the interrupted epoch is already trained. The epoch
+                # loop rolls such a resume into the NEXT epoch before it
+                # ever reaches here (train_validate_test's boundary check);
+                # a direct caller hitting this is consuming a stale sidecar
+                # — warn, because silently yielding a zero-length epoch
+                # would report the empty accumulator's 0.0 as a real loss.
+                import warnings
+
+                warnings.warn(
+                    f"set_resume_point({self._resume_skip}) >= epoch length "
+                    f"{len(plan)}: the interrupted epoch is already fully "
+                    "trained — yielding an empty epoch; resume into the "
+                    "next epoch instead"
+                )
             plan = plan[self._resume_skip:]
             self._resume_skip = 0
         return plan

@@ -42,6 +42,16 @@ through the train loop, superstep, checkpoint, and data layers:
   deadlines around each replica round-trip), and a mid-epoch checkpoint
   resumes EXACTLY onto a different device count — the interrupted epoch
   finishes on the saved logical update grid resharded over the new mesh.
+* **In-process elastic recovery** (``elastic.py``, ``Training.resilience.
+  elastic`` / ``HYDRAGNN_ELASTIC``): close the loop the above pieces permit
+  — on a recoverable fault (chaos ``device_loss``/``mesh_shrink``, SIGTERM,
+  a hung-dispatch ``watchdog_dispatch_s`` expiry) the run drains to the
+  dispatch boundary, snapshots, rebuilds the mesh from survivors, re-places
+  the state, and continues the SAME epoch without a process restart; K>1
+  supersteps finish their interrupted scan blocks on the saved logical grid
+  bit-exactly. The randomized chaos campaign (``campaign.py``) composes
+  multi-fault schedules and asserts zero lost samples / state agreement /
+  no leaked threads / bounded recovery after every one.
 
 Mode coverage: the guard wraps any ``(state, batch) -> (state, metrics)``
 step, so data-parallel, FSDP, edge-sharded, and pipeline steps all pass
@@ -80,11 +90,22 @@ class Resilience:
     checkpoint_on_preempt: bool = True
     checkpoint_every_epoch: bool = False
     watchdog_timeout: float = 0.0
+    # in-process elastic recovery (resilience/elastic.py): route preemption/
+    # host-loss/hung-dispatch faults through the ElasticController and
+    # resume on a re-built mesh instead of stopping the process
+    elastic: bool = False
+    max_recoveries: int = 4
+    # a DISPATCH taking longer than this (staging + step dispatch + the
+    # backpressure sync) fires the hung-dispatch watchdog; with a controller
+    # attached the expiry becomes a recoverable fault (drain + resume)
+    watchdog_dispatch_s: float = 0.0
 
     preempt: PreemptionHandler | None = None
     chaos: FaultPlan | None = None
     watchdog: Watchdog | None = None
+    dispatch_watchdog: Watchdog | None = None
     tracker: SkipTracker | None = None  # persistent skip-streak state
+    controller: object | None = None  # attached ElasticController
 
     # the Training.resilience config keys whose defaults ARE these dataclass
     # field defaults — the single source config.update_config and
@@ -97,6 +118,9 @@ class Resilience:
         "checkpoint_on_preempt",
         "checkpoint_every_epoch",
         "watchdog_timeout",
+        "elastic",
+        "max_recoveries",
+        "watchdog_dispatch_s",
     )
 
     # live state, written by the loop / train_epoch
@@ -106,6 +130,13 @@ class Resilience:
     preempted: bool = False  # loop saved a mid-epoch checkpoint and stopped
     skipped_total: int = 0  # guard-skipped steps, summed over the run
     rollbacks: int = 0
+    hung_dispatches: int = 0  # dispatch-watchdog expiries this run
+    # how the loop entered the current segment's first epoch, recorded for
+    # the elastic driver / tests: None (fresh), "exact", "elastic"
+    # (logical-grid reshard), "restart" (epoch-restart fallback),
+    # "next_epoch" (boundary sidecar), "epoch_start"
+    resume_mode: str | None = None
+    resume_reason: str | None = None
 
     @staticmethod
     def from_config(training_cfg: dict) -> "Resilience":
@@ -138,6 +169,17 @@ class Resilience:
             guard = bool(env_guard)
         d = config_defaults()  # dataclass field defaults, the single source
         timeout = float(cfg.get("watchdog_timeout", d["watchdog_timeout"]) or 0.0)
+        elastic = bool(cfg.get("elastic", d["elastic"]))
+        env_elastic = flags.get(flags.ELASTIC)
+        if env_elastic is not None:
+            elastic = bool(env_elastic)
+        dispatch_s = flags.get(
+            flags.WATCHDOG_DISPATCH_S,
+            default=float(
+                cfg.get("watchdog_dispatch_s", d["watchdog_dispatch_s"]) or 0.0
+            ),
+        )
+        dispatch_s = float(dispatch_s or 0.0)
         res = Resilience(
             guard_enabled=guard,
             max_consecutive_skips=int(
@@ -154,8 +196,12 @@ class Resilience:
                 cfg.get("checkpoint_every_epoch", d["checkpoint_every_epoch"])
             ),
             watchdog_timeout=timeout,
+            elastic=elastic,
+            max_recoveries=int(cfg.get("max_recoveries", d["max_recoveries"])),
+            watchdog_dispatch_s=dispatch_s,
             chaos=FaultPlan.from_env(),
             watchdog=Watchdog(timeout) if timeout > 0 else None,
+            dispatch_watchdog=Watchdog(dispatch_s) if dispatch_s > 0 else None,
         )
         if res.checkpoint_on_preempt:
             res.preempt = PreemptionHandler()
@@ -172,6 +218,39 @@ class Resilience:
 
     def preempt_requested(self) -> bool:
         return self.preempt is not None and self.preempt.requested
+
+    def request_checkpoint(self) -> None:
+        """Programmatic drain request (the elastic controller's channel):
+        identical effect to receiving SIGTERM — the loop stops at the next
+        dispatch boundary and saves a mid-epoch checkpoint."""
+        if self.preempt is None:
+            self.preempt = PreemptionHandler()  # event-only; never installed
+        self.preempt.request()
+
+    def note_hung_dispatch(self) -> None:
+        """Dispatch-watchdog expiry (``watchdog_dispatch_s``): count it and,
+        with an elastic controller attached, escalate to a recoverable fault
+        — the run drains at the boundary (once the wedged dispatch finally
+        returns) and resumes in process instead of burning walltime in
+        silence. Called from the watchdog's monitor thread."""
+        self.hung_dispatches += 1
+        if self.controller is not None:
+            from .elastic import Fault
+
+            self.controller.signal(
+                Fault(kind="hung_dispatch", detail="dispatch watchdog expiry")
+            )
+
+    def reset_for_resume(self) -> None:
+        """Clear the drain/preempt state before the elastic driver re-enters
+        the loop — without this the resumed segment would immediately see
+        the old request and drain again forever."""
+        if self.preempt is not None:
+            self.preempt.clear()
+        self.preempted = False
+        self.interrupted = False
+        self.resume_mode = None
+        self.resume_reason = None
 
     def new_tracker(self, lag: int) -> SkipTracker | None:
         """The run's skip-streak tracker, or None when the guard (or its
@@ -209,8 +288,18 @@ def config_defaults() -> dict:
     return {k: fields[k] for k in Resilience.CONFIG_KEYS}
 
 
+from .elastic import (  # noqa: E402 (needs Resilience defined for the driver)
+    ElasticController,
+    ElasticRecoveryError,
+    Fault,
+    train_elastic,
+)
+
 __all__ = [
     "DivergenceDetected",
+    "ElasticController",
+    "ElasticRecoveryError",
+    "Fault",
     "FaultPlan",
     "PreemptionHandler",
     "Resilience",
@@ -218,5 +307,6 @@ __all__ = [
     "TrainingDivergedError",
     "Watchdog",
     "config_defaults",
+    "train_elastic",
     "wrap_step_with_guard",
 ]

@@ -1,0 +1,296 @@
+"""Randomized chaos campaign: seeded multi-fault schedules + invariants.
+
+One injected fault proves one recovery path; production failure is
+*compositions* — a NaN blow-up two epochs before a preemption, a device loss
+while a peer is already quarantined, a second fault landing mid-recovery.
+This module turns the deterministic chaos harness (``chaos.py``) into a
+campaign: a seeded scheduler composes the fault vocabulary into random
+multi-fault ``HYDRAGNN_FAULT_PLAN`` schedules, and an invariant suite checks
+what graceful degradation actually MEANS after every schedule:
+
+1. **zero lost samples** — the faulted run performs exactly the reference
+   run's optimizer updates (exact resume never re-trains or drops a batch;
+   the logical-grid resume preserves the update count through a re-mesh);
+2. **state agreement** — bit-exact against the reference when the topology
+   never changed, allclose at the documented lr-scale tolerance after a
+   shrink (re-associated gradient reductions on fewer devices perturb
+   near-zero elements, and one Adam update turns any perturbation into an
+   O(lr) parameter move — see ``tests/test_elastic.py``'s derivation);
+3. **no leaked threads** — the run must not leave non-daemon threads behind
+   (the campaign's test module additionally runs under the
+   ``threadsan_module`` lock-order sanitizer, so the drills double as a
+   deadlock hunt);
+4. **bounded recovery** — every in-process recovery completes inside the
+   budget (drain -> snapshot -> re-mesh -> restore, measured to the point
+   the resumed segment re-enters the loop).
+
+Comparability discipline (why the scheduler constrains placement): the
+REFERENCE run replays the *training-perturbing* events (``nan_batch`` — both
+runs guard-skip the same poisoned update) but none of the recovery events.
+Fault coordinates are (epoch, dispatch-within-epoch), and a mid-epoch
+recovery restarts dispatch numbering for the resumed tail — so perturbing
+events must land in epochs strictly BEFORE the first recovery event, and
+mesh-changing events pin to the FINAL epoch (after a shrink, later epochs
+would regroup to the survivor-native grid: genuinely different update math,
+not noise). ``hang``/``dead_shard``/``slow_peer`` perturb nothing and may
+land anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# events both the reference and the faulted run must replay (they change the
+# training math itself, deterministically, via the non-finite guard skip)
+PERTURBING_FAULTS = ("nan_batch",)
+# events only the faulted run sees (they exercise recovery, not math)
+RECOVERY_FAULTS = ("sigterm", "device_loss", "mesh_shrink", "double_fault")
+# events that perturb neither math nor topology (timing / data-plane drills)
+BENIGN_FAULTS = ("hang", "dead_shard", "slow_peer")
+
+# the default draw set: everything except double_fault (a rider, drawn
+# separately) — topology faults included, since re-mesh recovery is the
+# headline path this campaign exists to prove; the scheduler prunes them
+# automatically when n_devices <= 1 (and the peer faults when n_peers == 0)
+DEFAULT_VOCAB = PERTURBING_FAULTS + BENIGN_FAULTS + (
+    "sigterm", "device_loss", "mesh_shrink",
+)
+
+
+def split_plan(events: list[dict]) -> tuple[list[dict], list[dict]]:
+    """``(reference_events, all_events)``: the reference run replays only the
+    training-perturbing subset."""
+    ref = [e for e in events if e.get("fault") in PERTURBING_FAULTS]
+    return ref, list(events)
+
+
+def random_fault_schedule(
+    seed: int,
+    *,
+    epochs: int,
+    dispatches: int,
+    n_devices: int = 1,
+    kinds=DEFAULT_VOCAB,
+    max_faults: int = 3,
+    n_peers: int = 0,
+) -> list[dict]:
+    """One seeded multi-fault schedule (a ``HYDRAGNN_FAULT_PLAN``-shaped
+    event list). Placement constraints keep the reference comparable (module
+    docstring): perturbing faults land in epochs before the final one;
+    recovery faults land in the final epoch; at most ``n_devices - 1``
+    devices ever die; ``double_fault`` only rides along with a recovery
+    fault. Deterministic per ``(seed, kwargs)``."""
+    rng = np.random.default_rng(seed)
+    kinds = [k for k in kinds]
+    if n_devices <= 1:
+        kinds = [k for k in kinds if k not in ("device_loss", "mesh_shrink")]
+    if n_peers <= 0:
+        kinds = [k for k in kinds if k not in ("dead_shard", "slow_peer")]
+    if epochs < 2:
+        # no pre-final epoch to put perturbing faults in
+        kinds = [k for k in kinds if k not in PERTURBING_FAULTS]
+    kinds = [k for k in kinds if k != "double_fault"]  # rider, drawn below
+    if not kinds:
+        raise ValueError("fault vocabulary is empty under the constraints")
+    n_faults = int(rng.integers(1, max(2, max_faults + 1)))
+    final = epochs - 1
+    loss_budget = max(0, n_devices - 1)  # devices that may still die
+    events: list[dict] = []
+    for _ in range(n_faults):
+        kind = kinds[int(rng.integers(len(kinds)))]
+        if kind in ("device_loss", "mesh_shrink") and loss_budget <= 0:
+            kind = "sigterm"
+        ev: dict = {"fault": kind}
+        if kind in PERTURBING_FAULTS:
+            ev["epoch"] = int(rng.integers(0, max(1, final)))
+            ev["dispatch"] = int(rng.integers(0, dispatches))
+        elif kind == "device_loss":
+            ev["epoch"] = final
+            ev["dispatch"] = int(rng.integers(0, dispatches))
+            ev["device"] = int(rng.integers(0, n_devices))
+            loss_budget -= 1
+        elif kind == "mesh_shrink":
+            # shrink no further than the remaining loss budget allows
+            lo = n_devices - loss_budget
+            target = int(rng.integers(lo, n_devices))
+            ev["epoch"] = final
+            ev["dispatch"] = int(rng.integers(0, dispatches))
+            ev["to"] = max(1, target)
+            loss_budget = max(0, target - 1)
+        elif kind == "sigterm":
+            ev["epoch"] = final
+            ev["dispatch"] = int(rng.integers(0, dispatches))
+        elif kind == "hang":
+            ev["epoch"] = int(rng.integers(0, epochs))
+            ev["dispatch"] = int(rng.integers(0, dispatches))
+            ev["seconds"] = round(float(rng.uniform(0.05, 0.2)), 3)
+        elif kind in ("dead_shard", "slow_peer"):
+            ev["epoch"] = int(rng.integers(0, epochs))
+            ev["dispatch"] = int(rng.integers(0, dispatches))
+            ev["peer"] = int(rng.integers(0, n_peers))
+            if kind == "slow_peer":
+                ev["seconds"] = round(float(rng.uniform(0.3, 0.8)), 3)
+        events.append(ev)
+    has_recovery = any(e["fault"] in RECOVERY_FAULTS for e in events)
+    if (
+        has_recovery and n_devices > 1 and loss_budget > 0
+        and "device_loss" in kinds and rng.random() < 0.5
+    ):
+        # ~half the recovery schedules add a fault DURING recovery
+        events.append(
+            {"fault": "double_fault", "inner": {"fault": "device_loss"}}
+        )
+    # deterministic order: epoch-major, then dispatch (the plan is taken in
+    # event order by the harness; sorting makes the schedule readable)
+    events.sort(
+        key=lambda e: (e.get("epoch", epochs), e.get("dispatch") or 0)
+    )
+    return events
+
+
+@dataclasses.dataclass
+class ScheduleOutcome:
+    """Everything the invariant suite needs from one executed schedule.
+    ``ref_state``/``state`` are final pytrees; ``lr`` scales the shrink
+    tolerance; ``approx_updates`` bounds how many optimizer updates ran
+    after the first topology change (each compounds the lr-scale drift);
+    ``threads_before``/``threads_after`` are non-daemon thread counts."""
+
+    seed: int
+    events: list
+    ref_state: object
+    state: object
+    controller: object
+    lr: float
+    mesh_changed: bool
+    approx_updates: int = 1
+    threads_before: int = 0
+    threads_after: int = 0
+    recovery_budget_ms: float = 60_000.0
+
+
+def nondaemon_thread_count() -> int:
+    import threading
+
+    return sum(1 for t in threading.enumerate() if not t.daemon)
+
+
+def _tree_leaves_host(tree):
+    import jax
+
+    from ..parallel.mesh import host_gather
+
+    return [np.asarray(x) for x in jax.tree.leaves(host_gather(tree))]
+
+
+def check_invariants(out: ScheduleOutcome) -> list[str]:
+    """The campaign's acceptance gate: returns human-readable violations
+    (empty = the schedule degraded gracefully)."""
+    violations: list[str] = []
+    ra, rb = _tree_leaves_host(out.ref_state), _tree_leaves_host(out.state)
+    if len(ra) != len(rb):
+        return [f"seed {out.seed}: state structure diverged"]
+    # zero lost samples: identical update counts (the step counter is a
+    # leaf, so the comparisons below cover it — but report it by name)
+    step_ref = _find_step(out.ref_state)
+    step_out = _find_step(out.state)
+    if step_ref is not None and step_out is not None and step_ref != step_out:
+        violations.append(
+            f"seed {out.seed}: lost/duplicated updates — step {step_out} "
+            f"vs reference {step_ref}"
+        )
+    atol = out.lr * max(1, int(out.approx_updates))
+    for i, (x, y) in enumerate(zip(ra, rb)):
+        if x.shape != y.shape or x.dtype != y.dtype:
+            violations.append(f"seed {out.seed}: leaf {i} shape/dtype diverged")
+            break
+        if not out.mesh_changed:
+            if not np.array_equal(x, y):
+                violations.append(
+                    f"seed {out.seed}: leaf {i} not BIT-exact though the "
+                    "topology never changed"
+                )
+                break
+        elif np.issubdtype(x.dtype, np.floating):
+            if not np.allclose(x, y, rtol=2e-2, atol=atol):
+                err = float(np.max(np.abs(x - y)))
+                violations.append(
+                    f"seed {out.seed}: leaf {i} off by {err:.2e} "
+                    f"(> lr-scale tolerance {atol:.2e} after shrink)"
+                )
+                break
+        elif not np.array_equal(x, y):
+            violations.append(f"seed {out.seed}: non-float leaf {i} diverged")
+            break
+    ctl = out.controller
+    if ctl is not None:
+        for rec in getattr(ctl, "recovery_log", ()):
+            if rec["recovery_ms"] > out.recovery_budget_ms:
+                violations.append(
+                    f"seed {out.seed}: recovery took {rec['recovery_ms']:.0f} "
+                    f"ms (> {out.recovery_budget_ms:.0f} ms budget)"
+                )
+        if getattr(ctl, "state", None) not in ("done", "running"):
+            violations.append(
+                f"seed {out.seed}: controller ended in state "
+                f"{getattr(ctl, 'state', None)!r}, not 'done'"
+            )
+    if out.threads_after > out.threads_before:
+        violations.append(
+            f"seed {out.seed}: {out.threads_after - out.threads_before} "
+            "non-daemon thread(s) leaked"
+        )
+    return violations
+
+
+def _find_step(state):
+    step = getattr(state, "step", None)
+    if step is None:
+        inner = getattr(state, "state", None)
+        step = getattr(inner, "step", None)
+    try:
+        return None if step is None else int(np.asarray(step).max())
+    except TypeError:
+        return None
+
+
+def run_campaign(seeds, run_schedule, **schedule_kw) -> dict:
+    """Execute one schedule per seed and collect the invariant verdicts.
+    ``run_schedule(seed, events) -> ScheduleOutcome`` is supplied by the
+    caller (it owns the model/loaders/driver); this function owns the
+    scheduling and the gate. Returns a report dict; ``report["violations"]``
+    empty means the whole campaign passed."""
+    report: dict = {"schedules": [], "violations": []}
+    for seed in seeds:
+        events = random_fault_schedule(int(seed), **schedule_kw)
+        outcome = run_schedule(int(seed), [dict(e) for e in events])
+        violations = check_invariants(outcome)
+        report["schedules"].append(
+            {
+                "seed": int(seed),
+                "events": events,
+                "recoveries": getattr(outcome.controller, "recoveries", 0),
+                "mesh_changed": outcome.mesh_changed,
+                "violations": violations,
+            }
+        )
+        report["violations"].extend(violations)
+    report["n_schedules"] = len(report["schedules"])
+    report["passed"] = not report["violations"]
+    return report
+
+
+__all__ = [
+    "BENIGN_FAULTS",
+    "DEFAULT_VOCAB",
+    "PERTURBING_FAULTS",
+    "RECOVERY_FAULTS",
+    "ScheduleOutcome",
+    "check_invariants",
+    "nondaemon_thread_count",
+    "random_fault_schedule",
+    "run_campaign",
+    "split_plan",
+]

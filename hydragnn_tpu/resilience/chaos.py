@@ -14,7 +14,10 @@ Plan format — a JSON list of events (inline, or ``@/path/to/plan.json``)::
       {"fault": "hang",      "epoch": 0, "dispatch": 2, "seconds": 1.5},
       {"fault": "corrupt_latest", "epoch": 0},
       {"fault": "dead_shard", "epoch": 0, "dispatch": 4, "peer": 1},
-      {"fault": "slow_peer",  "epoch": 0, "dispatch": 2, "peer": 0, "seconds": 5}
+      {"fault": "slow_peer",  "epoch": 0, "dispatch": 2, "peer": 0, "seconds": 5},
+      {"fault": "device_loss", "epoch": 1, "dispatch": 0, "device": 3},
+      {"fault": "mesh_shrink", "epoch": 1, "dispatch": 1, "to": 2},
+      {"fault": "double_fault", "inner": {"fault": "device_loss"}}
     ]'
 
 * ``nan_batch`` — multiply the batch's node features by NaN *after* device
@@ -38,6 +41,18 @@ Plan format — a JSON list of events (inline, or ``@/path/to/plan.json``)::
   ``seconds``: the gray-failure drill. A delay past the client's
   ``peer_timeout`` must escalate to quarantine + failover, not a stuck
   epoch.
+* ``device_loss`` — mark ``count`` devices (starting at ORIGINAL index
+  ``device``; default the last still-alive one) dead on the active
+  ``resilience.elastic`` controller: the COMPUTE-plane host-loss drill. The
+  loop drains to the dispatch boundary, checkpoints, and resumes on a mesh
+  rebuilt from the survivors — in process.
+* ``mesh_shrink`` — shrink the survivor list to ``to`` devices (the
+  multi-host-partition drill; same recovery path as ``device_loss``).
+* ``double_fault`` — fire the ``inner`` fault payload (``device_loss``,
+  ``mesh_shrink``, or ``sigterm``) while a recovery is ALREADY in flight:
+  proves recovery is re-entrant — a topology fault folds into the re-mesh
+  underway, a nested sigterm re-drains the resumed segment, and the
+  checkpoint sidecar records the logical grid exactly once either way.
 
 ``dispatch`` omitted/null matches every dispatch of the epoch; ``times``
 caps how often an event fires (default 1; -1 = unlimited).
@@ -55,8 +70,12 @@ from pathlib import Path
 
 _FAULTS = (
     "nan_batch", "sigterm", "hang", "corrupt_latest", "dead_shard",
-    "slow_peer",
+    "slow_peer", "device_loss", "mesh_shrink", "double_fault",
 )
+
+# double_fault payloads fire while a recovery is ALREADY in flight, so the
+# nested fault must itself be something the controller can absorb mid-flight
+_INNER_FAULTS = ("device_loss", "mesh_shrink", "sigterm")
 
 
 @dataclasses.dataclass
@@ -67,6 +86,10 @@ class FaultEvent:
     seconds: float = 1.0  # hang / slow_peer
     times: int = 1  # -1 = unlimited
     peer: int = 0  # dead_shard / slow_peer: index into live_servers()
+    device: int | None = None  # device_loss: ORIGINAL device index (None = last alive)
+    count: int = 1  # device_loss: how many devices die at once
+    to: int | None = None  # mesh_shrink: survivor-count target
+    inner: dict | None = None  # double_fault: the nested fault payload
 
     def matches(self, epoch: int, dispatch: int | None) -> bool:
         if self.times == 0 or self.epoch != epoch:
@@ -103,6 +126,15 @@ class FaultPlan:
                     f"HYDRAGNN_FAULT_PLAN event {i}: fault {fault!r} not one "
                     f"of {_FAULTS}"
                 )
+            inner = e.get("inner")
+            if fault == "double_fault":
+                inner = dict(inner or {"fault": "device_loss"})
+                if inner.get("fault") not in _INNER_FAULTS:
+                    raise ValueError(
+                        f"HYDRAGNN_FAULT_PLAN event {i}: double_fault inner "
+                        f"fault {inner.get('fault')!r} not one of "
+                        f"{_INNER_FAULTS}"
+                    )
             events.append(
                 FaultEvent(
                     fault=fault,
@@ -113,6 +145,12 @@ class FaultPlan:
                     seconds=float(e.get("seconds", 1.0)),
                     times=int(e.get("times", 1)),
                     peer=int(e.get("peer", 0)),
+                    device=(
+                        None if e.get("device") is None else int(e["device"])
+                    ),
+                    count=int(e.get("count", 1)),
+                    to=None if e.get("to") is None else int(e["to"]),
+                    inner=inner,
                 )
             )
         return FaultPlan(events)
@@ -150,9 +188,38 @@ class FaultPlan:
         ev = self._take("slow_peer", epoch, dispatch)
         if ev is not None:
             _slow_live_server(ev.peer, ev.seconds)
+        ev = self._take("device_loss", epoch, dispatch)
+        if ev is not None:
+            # host-loss drill for the COMPUTE plane: mark devices dead on
+            # the active elastic controller, which drains the loop to the
+            # dispatch boundary and re-meshes from the survivors
+            from .elastic import deliver_fault
+
+            deliver_fault("device_loss", device=ev.device, count=ev.count)
+        ev = self._take("mesh_shrink", epoch, dispatch)
+        if ev is not None:
+            from .elastic import deliver_fault
+
+            deliver_fault("mesh_shrink", to=ev.to)
         if self._take("nan_batch", epoch, dispatch) is not None:
             batch = poison_batch(batch)
         return batch
+
+    def on_recovery(self, recovery_no: int) -> list[dict]:
+        """Fault-during-recovery drill (``double_fault``): called by the
+        elastic controller's driver while a recovery is in flight, BEFORE it
+        re-meshes. Each pending double_fault event fires (consuming
+        ``times``) and contributes its nested fault payload — a topology
+        fault folds into the re-mesh already underway; a nested ``sigterm``
+        makes the resumed segment drain again immediately."""
+        out: list[dict] = []
+        for ev in self.events:
+            if ev.fault != "double_fault" or ev.times == 0:
+                continue
+            ev.consume()
+            self.log.append(("double_fault", -1, recovery_no))
+            out.append(dict(ev.inner or {"fault": "device_loss"}))
+        return out
 
     def on_epoch_end(self, epoch: int, log_name: str, path: str = "./logs/"):
         """Apply epoch-scoped faults (checkpoint corruption) after the

@@ -57,6 +57,13 @@ class PreemptionHandler:
     def _on_signal(self, signum, frame) -> None:  # signal context: flag only
         self._event.set()
 
+    def request(self) -> None:
+        """Programmatic checkpoint request — the elastic controller's drain
+        channel (``resilience/elastic.py``) and any in-process supervisor
+        use this instead of signalling themselves; identical loop-visible
+        effect to a delivered SIGTERM."""
+        self._event.set()
+
     @property
     def requested(self) -> bool:
         return self._event.is_set()

@@ -107,12 +107,45 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
                 f"now, but this job runs {world} processes — launch one "
                 "process, or drop to per-process subprocess trials"
             )
+        # Training.continue + Training.population: restore the [N]-stacked
+        # PopulationState through the ordinary checkpoint machinery — the
+        # stacked template (one init broadcast N ways) names the [N, ...]
+        # leaf shapes, so orbax round-trips fp32 master weights + per-member
+        # opt state (incl. injected hyperparameter stacks) + step counters;
+        # the sidecar's population_meta block carries the resume epoch and
+        # the per-member divergence bookkeeping
+        pop_resume = None  # (PopulationState, start_epoch, tracker_state)
         if training_cfg.get("continue"):
-            raise NotImplementedError(
-                "Training.continue with Training.population is not supported "
-                "yet: the checkpoint template is a single TrainState, not an "
-                "[N]-stacked population (restore a member via "
-                "train.population.member_state instead)"
+            from .train.checkpoint import load_checkpoint
+            from .train.population import PopulationState, population_template
+
+            startfrom = training_cfg.get("startfrom", log_name)
+            template = population_template(
+                model, optimizer, next(iter(train_loader)), pop_n
+            )
+            try:
+                restored, pmeta = load_checkpoint(template.state, startfrom)
+            except FileNotFoundError as e:
+                raise FileNotFoundError(
+                    f"Training.continue set but no checkpoint under "
+                    f"logs/{startfrom}: {e}"
+                )
+            saved_n = int(pmeta.get("population", 0) or 0)
+            if saved_n and saved_n != pop_n:
+                raise ValueError(
+                    f"checkpoint under logs/{startfrom} holds a "
+                    f"{saved_n}-member population but the config asks for "
+                    f"{pop_n}"
+                )
+            pop_resume = (
+                PopulationState(state=restored),
+                int(pmeta.get("population_epochs_done", pmeta.get("epoch", 0))),
+                pmeta.get("member_tracker"),
+            )
+            print_distributed(
+                verbosity,
+                f"resumed {pop_n}-member population from {startfrom} "
+                f"({pop_resume[1]} epoch(s) already trained)",
             )
         from .utils.walltime import make_walltime_check
 
@@ -146,17 +179,30 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             model, optimizer, train_loader, val_loader, test_loader,
             config["NeuralNetwork"], log_name, verbosity,
             walltime_check=make_walltime_check(),
+            initial_state=None if pop_resume is None else pop_resume[0],
+            start_epoch=0 if pop_resume is None else pop_resume[1],
+            tracker_state=None if pop_resume is None else pop_resume[2],
         )
         try:
             from .train.checkpoint import save_checkpoint
+            from .train.population import population_meta
 
             # the stacked TrainState has the single-state treedef with [N]
             # leaves, so the ordinary checkpoint machinery handles it;
-            # member_state(pstate, i) re-slices a winner for serving
+            # member_state(pstate, i) re-slices a winner for serving. The
+            # sidecar carries the full population_meta block so a later
+            # continue (e.g. num_epoch raised) resumes from here. Epochs
+            # done = what actually TRAINED (resume point + history length)
+            # — num_epoch would lie when the walltime guard broke the loop
+            # early, and a later continue would silently skip the rest.
+            epochs_done = int(summary.get("start_epoch", 0)) + len(
+                summary.get("history", [])
+            )
+            meta = {"final": True, **population_meta(pop_n, epochs_done)}
+            meta["member_tracker"] = summary.get("member_tracker")
+            meta["member_status"] = [m["status"] for m in summary["members"]]
             save_checkpoint(
-                pstate.state, log_name,
-                epoch=int(config["NeuralNetwork"]["Training"].get("num_epoch", 0)),
-                meta={"final": True, "population": pop_n},
+                pstate.state, log_name, epoch=epochs_done, meta=meta,
             )
         except Exception as e:
             print_distributed(verbosity, f"final population save failed: {e}")
@@ -221,6 +267,9 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
             "'data', 'tensor', 'pipeline'"
         )
     mesh = None
+    # how TrainState leaves are placed on the mesh — the elastic recovery
+    # path re-places the restored state with the same policy after a re-mesh
+    state_param_mode = "replicated"
     try:
         import jax
 
@@ -265,6 +314,7 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
                         f"{n_dev}-device mesh"
                     )
                 mesh = make_mesh(n_data=n_dev // tp, n_model=tp)
+                state_param_mode = "tp"
                 state = shard_state(state, mesh, param_mode="tp")
                 print_distributed(
                     verbosity,
@@ -279,6 +329,7 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
                     "fsdp" if _fsdp_requested and _fsdp_strategy != "NO_SHARD"
                     else "replicated"
                 )
+                state_param_mode = param_mode
                 state = shard_state(state, mesh, param_mode=param_mode)
                 print_distributed(
                     verbosity,
@@ -369,22 +420,42 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
 
     resilience = Resilience.from_config(training_cfg)
 
-    state = train_validate_test(
-        model,
-        optimizer,
-        state,
-        train_loader,
-        val_loader,
-        test_loader,
-        config["NeuralNetwork"],
-        log_name,
-        verbosity,
-        writer=writer,
-        walltime_check=make_walltime_check(),
-        mesh=mesh,
-        resilience=resilience,
-        resume_meta=resume_meta,
-    )
+    if resilience.elastic:
+        # in-process elastic recovery (resilience/elastic.py): preemption /
+        # host-loss / hung-dispatch faults drain to the dispatch boundary,
+        # re-mesh from survivors, and resume the SAME epoch without a
+        # process restart. Layouts with no in-process re-mesh (pipeline /
+        # edge-sharded / tensor) still route through the controller so the
+        # restart fallback is a logged policy decision, not dead-end flow.
+        from .resilience import ElasticController, train_elastic
+
+        controller = ElasticController(
+            max_recoveries=resilience.max_recoveries
+        )
+        state = train_elastic(
+            model, optimizer, state, train_loader, val_loader, test_loader,
+            config["NeuralNetwork"], log_name, verbosity, writer=writer,
+            walltime_check=make_walltime_check(), mesh=mesh,
+            resilience=resilience, resume_meta=resume_meta,
+            controller=controller, param_mode=state_param_mode,
+        )
+    else:
+        state = train_validate_test(
+            model,
+            optimizer,
+            state,
+            train_loader,
+            val_loader,
+            test_loader,
+            config["NeuralNetwork"],
+            log_name,
+            verbosity,
+            writer=writer,
+            walltime_check=make_walltime_check(),
+            mesh=mesh,
+            resilience=resilience,
+            resume_meta=resume_meta,
+        )
     if writer is not None:
         writer.close()
 

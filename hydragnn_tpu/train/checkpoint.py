@@ -62,7 +62,11 @@ def _ckpt_dir(log_name: str, path: str = "./logs/") -> str:
 def _read_json(path: str) -> dict:
     """Sidecar read with the shared transient-error retry policy: an EIO
     blip on a network filesystem retries with backoff; a missing file
-    raises immediately (absence is an answer, not a fault)."""
+    raises immediately (absence is an answer, not a fault), and so does a
+    file that EXISTS but does not parse — a writer that died mid-write
+    left it torn permanently, and paying the policy's full backoff budget
+    per corrupt manifest would turn the epoch-by-epoch restore fallback
+    into seconds of pointless sleeping per skipped candidate."""
     from ..utils.retry import SIDECAR_POLICY, call_with_retries
 
     def read():
@@ -73,7 +77,7 @@ def _read_json(path: str) -> dict:
         read,
         policy=SIDECAR_POLICY,
         retry_on=(OSError,),
-        give_up=(FileNotFoundError,),
+        give_up=(FileNotFoundError, json.JSONDecodeError),
         describe=f"sidecar read of {os.path.basename(path)}",
     )
 
@@ -247,13 +251,33 @@ def _restore_one(ckpt_path: str, template: TrainState, verify: bool):
                 template,
             )
             state = place_like(ckptr.restore(ckpt_path, host_abstract), template)
+    # writer-death hardening: a sidecar that exists but does not parse is a
+    # writer killed mid-write (between the temp write and its os.replace a
+    # crash leaves only the .tmp file — the REAL path torn means the
+    # non-atomic-write era or bit rot). Either way it is permanent: raise
+    # the typed corruption error immediately (zero retry sleeps, _read_json
+    # gives up on JSONDecodeError) so load_checkpoint's fallback walks to
+    # the previous epoch instead of stalling on backoff per candidate.
     manifest_file = ckpt_path + ".manifest.json"
     if verify and os.path.exists(manifest_file):
-        verify_manifest(state, _read_json(manifest_file), ckpt_path)
+        try:
+            manifest = _read_json(manifest_file)
+        except json.JSONDecodeError as e:
+            raise CheckpointCorruptError(
+                f"{ckpt_path}: manifest sidecar is torn ({e}) — the writer "
+                "died mid-write"
+            )
+        verify_manifest(state, manifest, ckpt_path)
     meta_file = ckpt_path + ".meta.json"
     meta = {}
     if os.path.exists(meta_file):
-        meta = _read_json(meta_file)
+        try:
+            meta = _read_json(meta_file)
+        except json.JSONDecodeError as e:
+            raise CheckpointCorruptError(
+                f"{ckpt_path}: meta sidecar is torn ({e}) — the writer died "
+                "mid-write"
+            )
     return state, meta
 
 

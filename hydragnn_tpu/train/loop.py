@@ -95,35 +95,45 @@ def _grouped(loader, n: int, mesh, fill: bool = False, put=None, phys=None):
         yield put(stack_device_batches(group), mesh)
 
 
-def _blocked(loader, k: int, n_dev: int, mesh):
+def _blocked(loader, k: int, n_dev: int, mesh, phys: int | None = None):
     """Group k*n_dev consecutive batches into ONE ``[K(, D), ...]`` superstep
     block. Fill semantics extend ``_grouped``: the trailing partial block pads
     with empty (all-masked) batches, which carry zero loss/stat weight AND
     zero state change (the superstep select-skips their optimizer update), so
     no loader batch is dropped and the final state bit-matches training on
-    only the real batches."""
+    only the real batches.
+
+    ``phys`` (elastic resume, the K>1 analogue of ``_grouped``'s): each scan
+    step's device stack pads from the LOGICAL width ``n_dev`` to ``phys``
+    with masked fill batches, so a saved K x n_dev update grid reshards onto
+    a rebuilt mesh whose device count doesn't divide the grid — every step
+    of the scan block still performs the interrupted run's exact update."""
     group = []
     for b in loader:
         group.append(b)
         if len(group) == k * n_dev:
-            yield _stage_block(group, k, n_dev, mesh)
+            yield _stage_block(group, k, n_dev, mesh, phys)
             group = []
     if group:
         group.extend([_empty_like(group[0])] * (k * n_dev - len(group)))
-        yield _stage_block(group, k, n_dev, mesh)
+        yield _stage_block(group, k, n_dev, mesh, phys)
 
 
-def _stage_block(batches, k: int, n_dev: int, mesh):
+def _stage_block(batches, k: int, n_dev: int, mesh, phys: int | None = None):
     """Stack k*n_dev host batches into one scan block and place it: with a
     mesh, axis 0 is the (on-device, iterated) scan axis and axis 1 the
-    data-sharded device axis; single-device blocks are just ``[K, ...]``."""
+    data-sharded device axis; single-device blocks are just ``[K, ...]``.
+    ``phys`` widens each step's device stack from ``n_dev`` to ``phys`` with
+    masked fill (see ``_blocked``)."""
     from ..parallel.step import put_block, stack_device_batches
 
+    phys = int(phys or n_dev)
     if mesh is not None:
-        steps = [
-            stack_device_batches(batches[i * n_dev : (i + 1) * n_dev])
-            for i in range(k)
-        ]
+        steps = []
+        for i in range(k):
+            row = batches[i * n_dev : (i + 1) * n_dev]
+            row = row + [_empty_like(row[0])] * (phys - n_dev)
+            steps.append(stack_device_batches(row))
         return put_block(stack_device_batches(steps), mesh)  # [K, D, ...]
     block = stack_device_batches(batches)  # [K, ...]
     return jax.tree.map(jnp.asarray, block)
@@ -251,11 +261,6 @@ def train_epoch(
             "put_fn or a group placement override (edge-sharded and "
             "pipeline modes pin K=1)"
         )
-    if group_phys and k > 1:
-        raise ValueError(
-            "group_phys (elastic resume stack padding) requires K=1 — "
-            "superstep blocks reshard at epoch boundaries only"
-        )
     per_dispatch = k * n_dev
     if per_dispatch > 1:
         # the HYDRAGNN_MAX_NUM_BATCH cap counts raw loader batches; each
@@ -265,8 +270,12 @@ def train_epoch(
         from .superstep import double_buffer
 
         # block staging (K-stack + device placement) happens one block ahead
-        # in a worker thread, overlapping the current superstep's execution
-        it = _timed_iter(double_buffer(_blocked(loader, k, n_dev, mesh)))
+        # in a worker thread, overlapping the current superstep's execution.
+        # group_phys (elastic resume): each scan step's stack pads from the
+        # saved logical width to the rebuilt mesh's physical width
+        it = _timed_iter(
+            double_buffer(_blocked(loader, k, n_dev, mesh, phys=group_phys))
+        )
     elif grouped:
         it = _timed_iter(
             # fill=True: the trailing partial device group trains too, padded
@@ -286,6 +295,25 @@ def train_epoch(
         if res is not None and res.watchdog is not None
         else (lambda what: nullcontext())
     )
+    # HYDRAGNN_WATCHDOG_DISPATCH_S: one deadline around the WHOLE dispatch
+    # (chaos hook + staging + step dispatch + backpressure sync). Expiry
+    # routes into the elastic controller as a recoverable hung-dispatch
+    # fault (res.note_hung_dispatch) — distinct from the sync-level
+    # watchdog above, which brackets individual blocking waits. The
+    # segment's FIRST dispatch is exempt: it legitimately pays the step
+    # program's compile (including after every elastic re-entry, whose
+    # fresh step closure re-keys the jit cache), and arming it would turn
+    # each recovery's warm-up into another "hung" fault — a recovery loop
+    # that burns the whole budget on compiles. The sync-level watchdog
+    # still covers a genuinely wedged first dispatch.
+    dwd = getattr(res, "dispatch_watchdog", None) if res is not None else None
+    dguard = (
+        (lambda ib: dwd.guard(
+            f"dispatch {ib}", on_expire=res.note_hung_dispatch
+        ) if ib > 0 else nullcontext())
+        if dwd is not None
+        else (lambda ib: nullcontext())
+    )
     chaos = res.chaos if res is not None else None
     tracker = res.new_tracker(_MAX_IN_FLIGHT) if res is not None else None
     epoch_no = res.current_epoch if res is not None else 0
@@ -302,18 +330,19 @@ def train_epoch(
                 # checkpoint from the progress recorded below
                 interrupted = True
                 break
-            if chaos is not None:
-                with wd("chaos dispatch hook"):
-                    batch = chaos.on_dispatch(epoch_no, ib, batch)
-            if put_fn is not None:
-                batch = put_fn(batch)
-            elif mesh is None and k == 1:
-                batch = jax.tree.map(jnp.asarray, batch)
-            state, metrics = train_step(state, batch)
-            step_metrics.append(metrics)
-            dispatches += 1
-            with wd("train step sync (backpressure)"):
-                _backpressure(step_metrics)
+            with dguard(ib):
+                if chaos is not None:
+                    with wd("chaos dispatch hook"):
+                        batch = chaos.on_dispatch(epoch_no, ib, batch)
+                if put_fn is not None:
+                    batch = put_fn(batch)
+                elif mesh is None and k == 1:
+                    batch = jax.tree.map(jnp.asarray, batch)
+                state, metrics = train_step(state, batch)
+                step_metrics.append(metrics)
+                dispatches += 1
+                with wd("train step sync (backpressure)"):
+                    _backpressure(step_metrics)
             if tracker is not None and "skipped" in metrics:
                 # deferred read: only values the backpressure window already
                 # waited for are materialized, so tracking never stalls the
@@ -446,14 +475,18 @@ def _reshard_resume_reason(saved_k, k_new, mesh, put_fn, group_put):
     """Why an exact mid-epoch resume onto a CHANGED dispatch layout is not
     possible — or None when it is (the elastic-resume path: finish the
     interrupted epoch on the saved logical update grid, resharded over the
-    current mesh). The raw-batch order is layout-invariant only for K=1
-    data-parallel grouping (grouping coarsens pads but never reorders the
-    plan; the superstep's bucket-major reorder depends on K x n_dev, so a
-    changed grid would resume into a differently-ordered batch stream)."""
-    if saved_k != k_new or saved_k > 1:
+    current mesh). The raw-batch order is layout-invariant whenever K and
+    the LOGICAL group width are preserved: grouping coarsens pads but never
+    reorders the plan, and the superstep's bucket-major reorder depends on
+    (K, group) — both pinned to their saved values for the resumed epoch —
+    so K>1 scan blocks finish on the saved grid too, each step's device
+    stack fill-padded up to the rebuilt mesh's width (``_blocked`` phys). A
+    CHANGED K names a differently-ordered batch stream and must restart."""
+    if saved_k != k_new:
         return (
-            "superstep block scheduling orders the epoch by the K x n_dev "
-            "grid, so the saved position names a different batch stream"
+            "steps_per_dispatch changed: superstep block scheduling orders "
+            "the epoch by the K x n_dev grid, so the saved position names a "
+            "different batch stream"
         )
     if put_fn is not None or group_put is not None:
         return (
@@ -685,6 +718,8 @@ def train_validate_test(
     start_epoch = 0
     resume_skip = 0
     resume_group = None  # saved LOGICAL update grid, when it differs
+    res.resume_mode = None
+    res.resume_reason = None
     if resume_meta and resume_meta.get("mid_epoch"):
         start_epoch = int(resume_meta.get("epoch", 0))
         resume_skip = int(resume_meta.get("raw_batches_done", 0))
@@ -705,6 +740,7 @@ def train_validate_test(
             )
             if reason is None:
                 resume_group = saved_ndev
+                res.resume_mode = "elastic"
                 print_distributed(
                     verbosity,
                     f"mid-epoch resume: device layout changed "
@@ -714,6 +750,7 @@ def train_validate_test(
                     "current mesh (exact resume)",
                 )
             else:
+                res.resume_mode, res.resume_reason = "restart", reason
                 print_distributed(
                     verbosity,
                     f"mid-epoch resume: dispatch layout changed "
@@ -738,6 +775,28 @@ def train_validate_test(
                 "first batch instead of an exact resume",
             )
             resume_skip = 0
+            resume_group = None
+            res.resume_mode = "restart"
+            res.resume_reason = "shuffle seed changed"
+        if resume_skip and resume_skip >= _max_num_batches(train_loader):
+            # preempted exactly at the epoch boundary (raw_batches_done ==
+            # epoch length): everything in the interrupted epoch is already
+            # trained — resume into the NEXT epoch, never a zero-length
+            # tail. An empty tail would report the zero-weight
+            # accumulator's 0.0 as a genuine loss, and the best-checkpoint
+            # logic would pin best=0.0 forever.
+            start_epoch += 1
+            resume_skip = 0
+            resume_group = None
+            res.resume_mode = "next_epoch"
+            res.resume_reason = "interrupted epoch was already complete"
+            print_distributed(
+                verbosity,
+                f"mid-epoch resume: the interrupted epoch's batches are all "
+                f"trained — resuming at epoch {start_epoch}",
+            )
+        if res.resume_mode is None:
+            res.resume_mode = "exact" if resume_skip else "epoch_start"
         if resume_meta.get("scheduler"):
             scheduler.load_state_dict(resume_meta["scheduler"])
         if checkpoint is not None and resume_meta.get("best_val") is not None:

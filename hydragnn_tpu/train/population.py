@@ -138,6 +138,21 @@ def create_population_state(
     return stack_states(members)
 
 
+def population_template(model, optimizer, example_batch, n_members: int) -> PopulationState:
+    """A restore TEMPLATE with the ``[N]``-stacked structure: one member
+    init broadcast N ways. Values are irrelevant — checkpoint restore only
+    reads the template's treedef/shapes/dtypes — so this costs ONE
+    ``create_train_state`` instead of N (``create_population_state`` pays N
+    inits because its VALUES matter). The stacked TrainState carries the
+    single-state treedef with ``[N, ...]`` leaves, so the ordinary
+    checkpoint machinery (orbax + manifest + sidecar) round-trips a whole
+    population — fp32 master weights, per-member opt state (including the
+    injected hyperparameter stacks), and per-member step counters — through
+    the files a single-state run would write."""
+    s = create_train_state(model, optimizer, example_batch)
+    return stack_states([s] * int(n_members))
+
+
 def _members_finite(tree, n: int) -> jax.Array:
     """``[N]`` bool: member ``i``'s floating leaves are all finite.
 
@@ -313,6 +328,33 @@ class MemberTracker:
     def statuses(self) -> list[str]:
         return ["diverged" if d else "ok" for d in self.diverged]
 
+    def state_dict(self) -> dict:
+        """Checkpoint-sidecar form of the tracker (drains deferred reads
+        first — a mid-lag snapshot would under-count the streaks)."""
+        self.finish()
+        return {
+            "diverged": [bool(d) for d in self.diverged],
+            "consecutive": [int(c) for c in self.consecutive],
+            "total": [int(t) for t in self.total],
+            "steps": int(self.steps),
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        """Restore a saved tracker: a member marked diverged STAYS diverged
+        across a resume (its restored state is the last finite one the
+        where-select froze; forgetting the mark would let it report "ok"
+        while re-diverging on its first resumed step)."""
+        self.diverged = np.asarray(
+            d.get("diverged", [False] * self.n_members), bool
+        ).copy()
+        self.consecutive = np.asarray(
+            d.get("consecutive", [0] * self.n_members), np.int64
+        ).copy()
+        self.total = np.asarray(
+            d.get("total", [0] * self.n_members), np.int64
+        ).copy()
+        self.steps = int(d.get("steps", 0))
+
 
 class _PopulationEpochHooks:
     """Duck-typed stand-in for the ``Resilience`` context ``train_epoch``
@@ -351,6 +393,22 @@ def _normalize_task_weights(weights, n_tasks: int) -> list[float]:
     return [x / wsum for x in w]
 
 
+def population_meta(n: int, epochs_done: int, tracker: MemberTracker | None = None) -> dict:
+    """Checkpoint-sidecar block for a population save: the member count (a
+    pre-restore sanity check — restoring an N-stack into an M-template
+    would die inside orbax with a shape soup), how many epochs the saved
+    state has fully trained (the continue resume point), and the per-member
+    divergence bookkeeping."""
+    meta = {
+        "population": int(n),
+        "population_epochs_done": int(epochs_done),
+    }
+    if tracker is not None:
+        meta["member_tracker"] = tracker.state_dict()
+        meta["member_status"] = tracker.statuses()
+    return meta
+
+
 def fit_population(
     model,
     optimizer,
@@ -365,10 +423,25 @@ def fit_population(
     task_weights: Sequence[Sequence[float]] | None = None,
     verbosity: int = 0,
     walltime_check=None,
+    initial_state: PopulationState | None = None,
+    start_epoch: int = 0,
+    tracker_state: dict | None = None,
+    log_name: str | None = None,
 ) -> tuple[PopulationState, dict]:
     """The population engine: train N members as one vmapped (and, at
     ``Training.steps_per_dispatch``/``HYDRAGNN_SUPERSTEP`` K>1,
     scan-folded) program for ``Training.num_epoch`` epochs.
+
+    Checkpoint/continue (``Training.continue`` + ``Training.population``):
+    ``initial_state`` is a RESTORED ``[N]``-stacked population (fp32 master
+    weights + per-member opt state incl. injected hyperparameter stacks —
+    see :func:`population_template`); training resumes at ``start_epoch``
+    with the per-member divergence bookkeeping re-seeded from
+    ``tracker_state``. The epoch stream is deterministic in (seed, epoch),
+    so a resumed run's remaining epochs bit-match an uninterrupted run's.
+    With ``log_name`` set and ``Training.resilience.checkpoint_every_epoch``
+    on, every epoch end writes a rolling population checkpoint whose sidecar
+    carries the member statuses — the resume point this path consumes.
 
     Returns ``(pstate, summary)`` where ``summary`` carries per-member
     records (status, final train/val loss, the member's hyperparameters)
@@ -407,14 +480,22 @@ def fit_population(
     dispatch_step = make_superstep(pop_step, k) if k > 1 else pop_step
     eval_step = make_population_eval_step(model, compute_dtype=precision)
 
-    example = next(iter(train_loader))
-    pstate = create_population_state(
-        model, optimizer, example, n, seeds=seeds,
-        hyperparams={
-            "learning_rate": learning_rates,
-            "weight_decay": weight_decays,
-        },
-    )
+    if initial_state is not None:
+        if initial_state.n_members != n:
+            raise ValueError(
+                f"restored population has {initial_state.n_members} members "
+                f"but the config asks for {n}"
+            )
+        pstate = initial_state  # hyperparam stacks ride the restored opt state
+    else:
+        example = next(iter(train_loader))
+        pstate = create_population_state(
+            model, optimizer, example, n, seeds=seeds,
+            hyperparams={
+                "learning_rate": learning_rates,
+                "weight_decay": weight_decays,
+            },
+        )
 
     res_cfg = training.get("resilience") or {}
     from ..resilience import config_defaults
@@ -425,6 +506,8 @@ def fit_population(
         )
     )
     tracker = MemberTracker(n, max_skips)
+    if tracker_state:
+        tracker.load_state_dict(tracker_state)
     hooks = _PopulationEpochHooks(tracker)
     acc = functools.partial(accumulate_members, n_members=n)
 
@@ -434,10 +517,23 @@ def fit_population(
     if len(getattr(val_loader, "samples", ())) == 0:
         skip_valtest = True
 
+    checkpoint_every = bool(res_cfg.get("checkpoint_every_epoch")) and log_name
+
+    def _rolling_save(epoch: int) -> None:
+        """Per-epoch population checkpoint: the stacked state through the
+        ordinary machinery, plus the sidecar a continue needs (member count
+        for a pre-restore sanity check, epochs done, tracker state)."""
+        from .checkpoint import save_checkpoint
+
+        save_checkpoint(
+            pstate.state, log_name, epoch,
+            meta=population_meta(n, epoch + 1, tracker),
+        )
+
     train_loss = np.full(n, np.nan)
     val_loss = np.full(n, np.nan)
     history = []
-    for epoch in range(num_epoch):
+    for epoch in range(start_epoch, num_epoch):
         train_loader.set_epoch(epoch)
         hooks.current_epoch = epoch
         pstate, train_loss, _ = train_epoch(
@@ -448,6 +544,8 @@ def fit_population(
             val_loss, _, _ = evaluate(
                 eval_step, pstate.state, val_loader, verbosity, accumulate=acc
             )
+        if checkpoint_every:
+            _rolling_save(epoch)
         history.append(
             {
                 "epoch": epoch,
@@ -504,6 +602,11 @@ def fit_population(
             "variance": float(np.var(finite)) if finite else None,
             "n_finite": len(finite),
         },
+        # the divergence bookkeeping in sidecar form, so a FINAL save's meta
+        # can carry it too and a later continue (num_epoch raised) resumes
+        # the streak/diverged state, not just the weights
+        "member_tracker": tracker.state_dict(),
+        "start_epoch": int(start_epoch),
         "history": history,
     }
     return pstate, summary
@@ -519,12 +622,18 @@ def train_population(
     log_name: str,
     verbosity: int = 0,
     walltime_check=None,
+    initial_state: PopulationState | None = None,
+    start_epoch: int = 0,
+    tracker_state: dict | None = None,
 ) -> tuple[PopulationState, dict]:
     """Config-driven front of :func:`fit_population`: reads the
     ``Training.population`` block (size / per-member seeds, learning rates,
     weight decays, task weights), trains the population, evaluates the test
     split per member, and writes the summary next to the run logs
-    (``logs/<run>/population.json``)."""
+    (``logs/<run>/population.json``). ``initial_state``/``start_epoch``/
+    ``tracker_state`` are the ``Training.continue`` resume point
+    (``run_training`` restores them via :func:`population_template` + the
+    checkpoint sidecar's :func:`population_meta` block)."""
     training = config_nn["Training"]
     pop_cfg = training.get("population") or {}
     n = resolve_population_size(training)
@@ -542,6 +651,10 @@ def train_population(
         task_weights=pop_cfg.get("task_weights"),
         verbosity=verbosity,
         walltime_check=walltime_check,
+        initial_state=initial_state,
+        start_epoch=start_epoch,
+        tracker_state=tracker_state,
+        log_name=log_name,
     )
     from ..utils import flags
     from .loop import evaluate
@@ -656,6 +769,8 @@ __all__ = [
     "make_population_objective",
     "make_population_step",
     "member_state",
+    "population_meta",
+    "population_template",
     "resolve_population_size",
     "stack_states",
     "train_population",

@@ -81,7 +81,34 @@ FAULT_PLAN = _register(Flag(
     "hang (sleep inside the watchdog-guarded dispatch), corrupt_latest "
     "(truncate the newest checkpoint after the epoch), dead_shard (kill a "
     "live ShardServer mid-epoch — the host-loss drill), slow_peer (delay a "
-    "server's responses past the fetch timeout — the gray-failure drill)."))
+    "server's responses past the fetch timeout — the gray-failure drill), "
+    "device_loss / mesh_shrink (mark compute devices dead on the elastic "
+    "controller — the COMPUTE-plane host-loss drill; needs "
+    "HYDRAGNN_ELASTIC), double_fault (fire a nested fault while a recovery "
+    "is already in flight). resilience/campaign.py composes these into "
+    "seeded randomized multi-fault schedules."))
+ELASTIC = _register(Flag(
+    "HYDRAGNN_ELASTIC", "bool", None,
+    "In-process elastic recovery (resilience/elastic.py; overrides "
+    "Training.resilience.elastic, default off). On a recoverable fault — "
+    "chaos device_loss/mesh_shrink, SIGTERM, or a hung-dispatch watchdog "
+    "expiry — the run drains to the dispatch boundary, checkpoints, "
+    "rebuilds the data mesh from the surviving devices, re-places the "
+    "TrainState, and continues the SAME epoch without a process restart "
+    "(same-mesh resumes bit-exact incl. K>1 supersteps; shrunk meshes "
+    "allclose at lr-scale). Pipeline/edge-sharded/tensor layouts take a "
+    "logged restart-fallback policy instead."))
+WATCHDOG_DISPATCH_S = _register(Flag(
+    "HYDRAGNN_WATCHDOG_DISPATCH_S", "float", None,
+    "Per-DISPATCH hang deadline in seconds (overrides "
+    "Training.resilience.watchdog_dispatch_s; unset/0 disables): a timer "
+    "armed around each train-step dispatch (staging + dispatch + the "
+    "backpressure sync) EXCEPT a segment's first, which legitimately pays "
+    "the step compile. Expiry warns, and with elastic recovery active it "
+    "becomes a recoverable fault — the run drains at the next boundary and "
+    "resumes in process instead of burning walltime in silence. Distinct "
+    "from resilience.watchdog_timeout, which brackets individual blocking "
+    "device syncs/peer round-trips."))
 DUMP_TESTDATA = _register(Flag(
     "HYDRAGNN_DUMP_TESTDATA", "bool", False,
     "Dump per-rank test true/pred pickles (reference :908)."))

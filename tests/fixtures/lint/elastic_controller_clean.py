@@ -1,0 +1,70 @@
+"""Sanctioned elastic-recovery controller patterns (resilience/elastic.py).
+
+The recovery controller is HOST code shared between the training thread and
+watchdog/monitor threads. Its shape must stay silent under every GL rule:
+
+- fault intake mutates guarded state under ONE lock, with every guarded
+  attribute carrying its ``# guarded-by:`` declaration (GL101) and no
+  nested second lock (GL102 stays acyclic);
+- readers hand back FRESH objects — ``survivors()`` builds a new list,
+  ``take_pending()`` swaps the buffer — never an alias of a guarded
+  mutable (GL107);
+- deadlines and recovery timings use ``time.monotonic()``; ``time.time()``
+  in deadline arithmetic is exactly what GL105 hunts;
+- the drain request leaves the lock before touching the OTHER lock domain
+  (the preempt handler's Event), so no cross-domain hold-while-acquiring
+  edge exists for the runtime sanitizer either;
+- nothing here is jit-reachable: the controller never touches traced
+  values, so GL001/GL002 have nothing to flag.
+"""
+import threading
+import time
+
+
+class CleanController:
+    def __init__(self, devices):
+        self._lock = threading.Lock()
+        self._all = list(devices)  # guarded-by: _lock
+        self._lost = set()  # guarded-by: _lock
+        self._pending = []  # guarded-by: _lock
+        self.state = "running"  # guarded-by: _lock
+        self.drain_requested = threading.Event()  # its own lock domain
+
+    def signal(self, fault: dict) -> None:
+        """Fault intake — safe from watchdog/monitor threads."""
+        stamped = dict(fault)
+        stamped.setdefault("t_signal", time.monotonic())  # never time.time()
+        with self._lock:
+            self._pending.append(stamped)
+            self.state = "draining"
+        # OUTSIDE the lock: the Event has its own lock; holding ours across
+        # set() would add a needless cross-domain edge
+        self.drain_requested.set()
+
+    def take_pending(self) -> list:
+        with self._lock:
+            out, self._pending = self._pending, []
+            return out  # swapped out: the caller owns it, no alias escapes
+
+    def survivors(self) -> list:
+        with self._lock:
+            # a FRESH list every call — returning self._all would alias the
+            # guarded mutable into unlocked caller code
+            return [d for i, d in enumerate(self._all) if i not in self._lost]
+
+    def apply_loss(self, index: int) -> None:
+        with self._lock:
+            self._lost.add(index)
+            if len(self._lost) >= len(self._all):
+                self.state = "failed"
+
+
+def timed_recovery(controller, remesh):
+    """The driver's recovery bracket: monotonic wall timing around the
+    re-mesh, with the state transitions under the controller's lock."""
+    t0 = time.monotonic()
+    faults = controller.take_pending()
+    mesh = remesh(controller.survivors())
+    with controller._lock:
+        controller.state = "resumed"
+    return mesh, faults, 1e3 * (time.monotonic() - t0)

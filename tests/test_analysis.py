@@ -205,6 +205,17 @@ def test_fleet_router_thread_socket_code_is_clean():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_elastic_controller_shape_is_clean():
+    """The elastic recovery controller's shape (resilience/elastic.py:
+    fault intake from monitor threads under one lock with guarded-by
+    declarations, fresh-object readers, monotonic deadlines, the drain
+    Event touched outside the lock) is sanctioned host code: every rule —
+    GL101's guarded-attr hunt and GL105's wall-clock-deadline hunt above
+    all — must stay silent on it."""
+    findings = analyze([str(FIXTURES / "elastic_controller_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_gl003_scan_folded_steps_are_clean():
     """lax.scan-folded supersteps (train/superstep.py's pattern: one jitted
     scan built outside the loop, dispatched per block) are the sanctioned

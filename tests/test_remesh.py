@@ -1,0 +1,659 @@
+"""In-process elastic recovery: live re-mesh after preemption/host loss (ISSUE 14).
+
+Every claim is proven against an injected fault through the REAL epoch loop:
+
+* a SIGTERM mid-epoch on a K>1 superstep run drains to the dispatch
+  boundary, checkpoints, and resumes the SAME epoch in process — final
+  state bit-exact vs the uninterrupted run;
+* a ``device_loss`` chaos fault rebuilds the mesh from the survivors and
+  finishes the interrupted epoch on the saved logical K x n_dev grid
+  (allclose at the documented lr-scale tolerance — same derivation as
+  ``tests/test_elastic.py``), zero samples lost or double-trained;
+* a fault DURING recovery (``double_fault``) folds into the re-mesh under
+  way / re-drains the resumed segment, and the sidecar records the logical
+  grid exactly once;
+* a hung dispatch (chaos ``hang`` past ``watchdog_dispatch_s``) escalates
+  into the same recovery path instead of burning walltime in silence;
+* an unrecoverable topology (no survivors) or an exhausted recovery budget
+  raises ``ElasticRecoveryError`` with the mid-epoch checkpoint intact on
+  disk as the resume point for a replacement job;
+* a writer killed between a sidecar's temp-write and its ``os.replace``
+  leaves a checkpoint the restore path falls back THROUGH — epoch by epoch,
+  with zero retry-budget sleeps per torn manifest;
+* ``Training.continue`` + ``Training.population`` restores the [N]-stacked
+  ``PopulationState`` and bit-matches an uninterrupted population run.
+
+Slow budget (declared up front, ROADMAP 870 s constraint): 2 slow tests —
+the population continue e2e (~30 s: three small runs, one vmap compile
+each) and the 2-member template round-trip rides non-slow. Everything else
+is non-slow and shares the process-wide jit cache with test_elastic.py's
+mesh programs (~45 s measured solo for the module's non-slow set).
+"""
+
+import copy
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.datasets import deterministic_graph_data
+from hydragnn_tpu.graphs.batching import GraphLoader
+from hydragnn_tpu.models import create_model_config
+from hydragnn_tpu.parallel import host_gather, make_mesh, shard_state
+from hydragnn_tpu.preprocess import apply_variables_of_interest
+from hydragnn_tpu.resilience import (
+    ElasticController,
+    ElasticRecoveryError,
+    Fault,
+    FaultPlan,
+    Resilience,
+    train_elastic,
+)
+from hydragnn_tpu.resilience.elastic import active_controller, deliver_fault
+from hydragnn_tpu.train import create_train_state, select_optimizer
+from hydragnn_tpu.train.checkpoint import (
+    CheckpointCorruptError,
+    load_checkpoint,
+    save_checkpoint,
+)
+from hydragnn_tpu.train.loop import train_validate_test
+
+from test_config import CI_CONFIG
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _threadsan(threadsan_module):
+    """Controller/watchdog/preempt locks run under the lock-order sanitizer
+    for the whole module; the recovery drills double as a deadlock hunt."""
+    yield threadsan_module
+
+
+@pytest.fixture()
+def in_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_VALTEST", "0")
+    return tmp_path
+
+
+N_SAMPLES = 48
+BATCH = 4  # 12 raw batches per epoch
+
+
+def _fixture(num_epoch=2, k=2):
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=N_SAMPLES, seed=9)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    nn = copy.deepcopy(cfg["NeuralNetwork"])
+    nn["Training"]["num_epoch"] = num_epoch
+    if k > 1:
+        nn["Training"]["steps_per_dispatch"] = k
+    model = create_model_config(cfg)
+    opt = select_optimizer(nn["Training"]["Optimizer"])
+    return nn, model, opt, samples
+
+
+def _loaders(samples):
+    return (
+        GraphLoader(samples, BATCH, shuffle=False),
+        GraphLoader(samples[:8], BATCH),
+        GraphLoader(samples[8:16], BATCH),
+    )
+
+
+def _fresh_state(model, opt, samples, mesh):
+    tl, _, _ = _loaders(samples)
+    state = create_train_state(model, opt, next(iter(tl)))
+    return shard_state(state, mesh) if mesh is not None else state
+
+
+def _run_plain(nn, model, opt, samples, mesh, log_name):
+    tl, vl, sl = _loaders(samples)
+    return train_validate_test(
+        model, opt, _fresh_state(model, opt, samples, mesh), tl, vl, sl,
+        nn, log_name, verbosity=0, mesh=mesh,
+    )
+
+
+def _run_elastic(nn, model, opt, samples, mesh, log_name, plan=None,
+                 controller=None, res_overrides=None):
+    tl, vl, sl = _loaders(samples)
+    res = Resilience.from_config(nn["Training"])
+    for key, val in (res_overrides or {}).items():
+        setattr(res, key, val)
+    if plan is not None:
+        res.chaos = FaultPlan.parse(plan)
+    ctl = controller if controller is not None else ElasticController()
+    state = train_elastic(
+        model, opt, _fresh_state(model, opt, samples, mesh), tl, vl, sl,
+        nn, log_name, verbosity=0, mesh=mesh, resilience=res, controller=ctl,
+    )
+    return state, ctl, res
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree.leaves(host_gather(tree))]
+
+
+def _assert_bit_exact(a, b):
+    for x, y in zip(_leaves(a), _leaves(b)):
+        np.testing.assert_array_equal(x, y)
+
+
+def _assert_lr_close(a, b, lr, updates=1):
+    atol = lr * max(1, updates)
+    for x, y in zip(_leaves(a), _leaves(b)):
+        if np.issubdtype(x.dtype, np.floating):
+            np.testing.assert_allclose(x, y, rtol=2e-2, atol=atol)
+        else:
+            np.testing.assert_array_equal(x, y)
+
+
+# -- controller units ---------------------------------------------------------
+
+
+def test_controller_survivor_bookkeeping():
+    ctl = ElasticController(devices=list("abcd"))
+    assert ctl.survivors() == list("abcd")
+    desc = ctl.apply(Fault(kind="device_loss", device=2))
+    assert "2" in desc and ctl.survivors() == list("abd")
+    # count>1 walks DOWN over still-alive indices (2 is already dead, so
+    # the victims are 3 and 1)
+    ctl.apply(Fault(kind="device_loss", device=3, count=2))
+    assert ctl.survivors() == ["a"] and ctl.lost_indices() == (1, 2, 3)
+    # naming a dead index with nothing alive at-or-below it is inert
+    ctl2 = ElasticController(devices=list("ab"))
+    ctl2.apply(Fault(kind="device_loss", device=0))
+    assert "inert" in ctl2.apply(Fault(kind="device_loss", device=0))
+    with pytest.raises(ElasticRecoveryError, match="zero surviving"):
+        ctl.apply(Fault(kind="device_loss", device=0))
+
+
+def test_fault_kind_validated_and_budget_flagged():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        Fault(kind="devcie_loss")  # the typo must not silently no-op
+    ctl = ElasticController(devices=list("ab"), recovery_budget_s=0.001)
+    with pytest.warns(UserWarning, match="over the controller's"):
+        ctl.note_recovery([Fault(kind="sigterm")], "resume", 5.0, {})
+    assert ctl.recovery_log[0]["over_budget"] is True
+    ctl2 = ElasticController(devices=list("ab"))
+    ctl2.note_recovery([Fault(kind="sigterm")], "resume", 5.0, {})
+    assert ctl2.recovery_log[0]["over_budget"] is False
+
+
+def test_controller_mesh_shrink_and_bind_idempotent():
+    ctl = ElasticController()
+    ctl.bind_devices(list("abcd"))
+    ctl.bind_devices(list("xy"))  # first bind wins: indices stay stable
+    ctl.apply(Fault(kind="mesh_shrink", to=2))
+    assert ctl.survivors() == list("ab")
+    ctl.apply(Fault(kind="mesh_shrink", to=3))  # never grows back
+    assert ctl.survivors() == list("ab")
+
+
+def test_controller_signal_drains_and_reset_clears():
+    res = Resilience.from_config({})
+    ctl = ElasticController()
+    ctl.attach(res)
+    assert res.controller is ctl and not res.preempt_requested()
+    ctl.signal(Fault(kind="sigterm"))
+    assert res.preempt_requested() and ctl.state == "draining"
+    faults = ctl.take_pending()
+    assert [f.kind for f in faults] == ["sigterm"]
+    assert faults[0].t_signal > 0  # stamped at signal time
+    res.reset_for_resume()
+    assert not res.preempt_requested() and not ctl.pending()
+
+
+def test_hung_dispatch_routes_into_controller():
+    res = Resilience.from_config({})
+    ctl = ElasticController()
+    ctl.attach(res)
+    res.note_hung_dispatch()
+    assert res.hung_dispatches == 1
+    assert [f.kind for f in ctl.take_pending()] == ["hung_dispatch"]
+    # without a controller: counted, not escalated
+    res2 = Resilience.from_config({})
+    res2.note_hung_dispatch()
+    assert res2.hung_dispatches == 1
+
+
+def test_plan_remesh_policies():
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    ctl = ElasticController(devices=devs[:4])
+    mesh4 = make_mesh(devices=devs[:4])
+    # no loss: same-mesh resume
+    assert ctl.plan_remesh(mesh4, {})[1] == "resume"
+    ctl.apply(Fault(kind="device_loss", device=3))
+    new_mesh, mode, reason = ctl.plan_remesh(mesh4, {})
+    assert mode == "remesh" and new_mesh.devices.size == 3
+    # no mesh to rebuild -> restart fallback (policy, not an exception)
+    assert ctl.plan_remesh(None, {})[1] == "restart_fallback"
+    # edge-sharded / pipeline / tensor layouts pin their device count
+    arch = {"Architecture": {"edge_sharding": True}}
+    assert ctl.plan_remesh(mesh4, arch)[1] == "restart_fallback"
+    pipe = Mesh(np.asarray(devs[:2]), ("stage",))
+    _, mode, reason = ctl.plan_remesh(pipe, {})
+    assert mode == "restart_fallback" and "pipeline" in reason
+    tp = make_mesh(n_data=4, n_model=2)
+    _, mode, reason = ctl.plan_remesh(tp, {})
+    assert mode == "restart_fallback" and "model-axis" in reason
+
+
+def test_deliver_fault_without_controller_is_inert(capsys):
+    assert active_controller() is None
+    assert deliver_fault("device_loss", device=0) is False
+    assert "no active ElasticController" in capsys.readouterr().err
+
+
+def test_fault_plan_new_kinds_parse_and_validate():
+    plan = FaultPlan.parse(
+        '[{"fault": "device_loss", "epoch": 1, "device": 3, "count": 2},'
+        ' {"fault": "mesh_shrink", "epoch": 1, "to": 2},'
+        ' {"fault": "double_fault", "inner": {"fault": "sigterm"}}]'
+    )
+    assert [e.fault for e in plan.events] == [
+        "device_loss", "mesh_shrink", "double_fault"
+    ]
+    assert plan.events[0].count == 2 and plan.events[1].to == 2
+    assert plan.events[2].inner == {"fault": "sigterm"}
+    with pytest.raises(ValueError, match="double_fault inner"):
+        FaultPlan.parse('[{"fault": "double_fault", "inner": {"fault": "hang"}}]')
+
+
+def test_elastic_flags_registered():
+    from hydragnn_tpu.utils import flags
+
+    from hydragnn_tpu.resilience.chaos import _FAULTS
+
+    assert flags.ELASTIC.name == "HYDRAGNN_ELASTIC"
+    assert flags.WATCHDOG_DISPATCH_S.name == "HYDRAGNN_WATCHDOG_DISPATCH_S"
+    assert "rebuild" in flags.ELASTIC.help
+    for kind in ("device_loss", "mesh_shrink", "double_fault"):
+        assert kind in _FAULTS
+        assert kind in flags.FAULT_PLAN.help or kind in _FAULTS
+
+
+def test_resilience_config_block_and_env_overrides(monkeypatch):
+    res = Resilience.from_config(
+        {"resilience": {"elastic": True, "max_recoveries": 7,
+                        "watchdog_dispatch_s": 1.5}}
+    )
+    assert res.elastic and res.max_recoveries == 7
+    assert res.watchdog_dispatch_s == 1.5
+    assert res.dispatch_watchdog is not None
+    monkeypatch.setenv("HYDRAGNN_ELASTIC", "0")
+    monkeypatch.setenv("HYDRAGNN_WATCHDOG_DISPATCH_S", "0")
+    res2 = Resilience.from_config(
+        {"resilience": {"elastic": True, "watchdog_dispatch_s": 1.5}}
+    )
+    assert not res2.elastic and res2.dispatch_watchdog is None
+    # schema: the new keys are defaulted into Training.resilience
+    from hydragnn_tpu.resilience import config_defaults
+
+    d = config_defaults()
+    assert d["elastic"] is False and d["watchdog_dispatch_s"] == 0.0
+    assert d["max_recoveries"] == 4
+
+
+# -- in-process recovery e2e --------------------------------------------------
+
+
+def test_sigterm_superstep_resumes_in_process_bit_exact(in_tmp):
+    """ISSUE 14 acceptance: SIGTERM mid-epoch on a K=2 superstep mesh run
+    drains, snapshots, and resumes the SAME epoch without a process restart
+    — final state bit-exact vs the uninterrupted run, zero lost samples."""
+    nn, model, opt, samples = _fixture(num_epoch=2, k=2)
+    mesh4 = make_mesh(devices=jax.devices()[:4])
+    ref = _run_plain(nn, model, opt, samples, mesh4, "remesh_ref_k2")
+    out, ctl, res = _run_elastic(
+        nn, model, opt, samples, mesh4, "remesh_sig_k2",
+        plan='[{"fault": "sigterm", "epoch": 1, "dispatch": 0}]',
+    )
+    assert ctl.recoveries == 1 and ctl.state == "done"
+    assert ctl.recovery_log[0]["mode"] == "resume"
+    assert not res.preempted  # the run FINISHED, in process
+    assert res.resume_mode == "exact"
+    # zero lost samples: identical update count, and bit-identical state
+    assert int(np.asarray(out.step)) == int(np.asarray(ref.step))
+    _assert_bit_exact(ref, out)
+
+
+def test_device_loss_superstep_remeshes_allclose(in_tmp):
+    """ISSUE 14 acceptance: device_loss mid-epoch on a K=2 superstep run
+    rebuilds the mesh from the 3 survivors and finishes the interrupted
+    epoch on the saved logical K x 4 grid — allclose at the documented
+    lr-scale tolerance (re-associated reductions on a changed device count
+    + one Adam update per remaining dispatch), zero lost samples."""
+    nn, model, opt, samples = _fixture(num_epoch=2, k=2)
+    mesh4 = make_mesh(devices=jax.devices()[:4])
+    ref = _run_plain(nn, model, opt, samples, mesh4, "remesh_ref2_k2")
+    out, ctl, res = _run_elastic(
+        nn, model, opt, samples, mesh4, "remesh_dl_k2",
+        plan='[{"fault": "device_loss", "epoch": 1, "dispatch": 0}]',
+    )
+    assert ctl.recoveries == 1 and ctl.lost_indices() == (3,)
+    rec = ctl.recovery_log[0]
+    assert rec["mode"] == "remesh" and rec["logical_n_dev"] == 4
+    assert rec["recovery_ms"] < 60_000  # bounded recovery
+    assert res.resume_mode == "elastic"  # saved grid resharded over 3 devs
+    assert int(np.asarray(out.step)) == int(np.asarray(ref.step))
+    lr = float(nn["Training"]["Optimizer"]["learning_rate"])
+    _assert_lr_close(ref, out, lr, updates=1)
+
+
+def test_double_fault_folds_into_one_remesh(in_tmp):
+    """A topology fault injected DURING recovery folds into the re-mesh
+    already under way: one recovery absorbs both losses, and the sidecar
+    records the logical grid exactly once."""
+    nn, model, opt, samples = _fixture(num_epoch=2, k=1)
+    mesh4 = make_mesh(devices=jax.devices()[:4])
+    ref = _run_plain(nn, model, opt, samples, mesh4, "remesh_ref_df")
+    out, ctl, res = _run_elastic(
+        nn, model, opt, samples, mesh4, "remesh_df",
+        plan='[{"fault": "device_loss", "epoch": 1, "dispatch": 0},'
+             ' {"fault": "double_fault", "inner": {"fault": "device_loss"}}]',
+    )
+    assert ctl.recoveries == 1  # ONE recovery absorbed both losses
+    assert len(ctl.lost_indices()) == 2
+    assert ctl.recovery_log[0]["logical_n_dev"] == 4  # recorded once
+    assert int(np.asarray(out.step)) == int(np.asarray(ref.step))
+    lr = float(nn["Training"]["Optimizer"]["learning_rate"])
+    _assert_lr_close(ref, out, lr, updates=2)
+
+
+def test_double_fault_nested_sigterm_redrains(in_tmp):
+    """A nested sigterm during recovery re-drains the RESUMED segment: two
+    recoveries total, the re-preempted sidecar still names the logical
+    grid, and the final state stays bit-exact (topology never changed)."""
+    nn, model, opt, samples = _fixture(num_epoch=2, k=1)
+    mesh4 = make_mesh(devices=jax.devices()[:4])
+    ref = _run_plain(nn, model, opt, samples, mesh4, "remesh_ref_ns")
+    out, ctl, res = _run_elastic(
+        nn, model, opt, samples, mesh4, "remesh_ns",
+        plan='[{"fault": "sigterm", "epoch": 1, "dispatch": 0},'
+             ' {"fault": "double_fault", "inner": {"fault": "sigterm"}}]',
+    )
+    assert ctl.recoveries == 2  # the nested sigterm forced a second drain
+    assert ctl.state == "done"
+    assert int(np.asarray(out.step)) == int(np.asarray(ref.step))
+    _assert_bit_exact(ref, out)
+
+
+def test_hung_dispatch_escalates_to_recovery(in_tmp):
+    """Chaos ``hang`` past ``watchdog_dispatch_s``: the per-dispatch timer
+    fires from the monitor thread, routes into the controller as a
+    recoverable fault, and the run drains + resumes in process — final
+    state bit-exact (a hang perturbs nothing)."""
+    nn, model, opt, samples = _fixture(num_epoch=2, k=1)
+    nn["Training"].setdefault("resilience", {})["watchdog_dispatch_s"] = 0.3
+    ref = _run_plain(nn, model, opt, samples, None, "remesh_ref_hang")
+    # hang at dispatch 1: a segment's FIRST dispatch is exempt (it pays
+    # the step compile — arming it would turn every recovery's warm-up
+    # into another "hung" fault and loop away the whole budget)
+    with pytest.warns(UserWarning, match="dispatch"):
+        out, ctl, res = _run_elastic(
+            nn, model, opt, samples, None, "remesh_hang",
+            plan='[{"fault": "hang", "epoch": 1, "dispatch": 1,'
+                 ' "seconds": 1.0}]',
+        )
+    assert res.hung_dispatches >= 1
+    assert ctl.recoveries == 1
+    assert ctl.recovery_log[0]["faults"] == ["hung_dispatch"]
+    _assert_bit_exact(ref, out)
+
+
+def test_no_survivors_raises_with_checkpoint_on_disk(in_tmp):
+    """Losing every device is unrecoverable in process: the driver raises
+    ``ElasticRecoveryError`` — but the mid-epoch checkpoint it drained to
+    is on disk as the resume point for a replacement job."""
+    nn, model, opt, samples = _fixture(num_epoch=2, k=1)
+    mesh2 = make_mesh(devices=jax.devices()[:2])
+    with pytest.raises(ElasticRecoveryError, match="zero surviving"):
+        _run_elastic(
+            nn, model, opt, samples, mesh2, "remesh_dead",
+            plan='[{"fault": "device_loss", "epoch": 1, "dispatch": 0,'
+                 ' "count": 2}]',
+        )
+    template = create_train_state(model, opt, next(iter(_loaders(samples)[0])))
+    _, meta = load_checkpoint(template, "remesh_dead")
+    assert meta["mid_epoch"] and meta["epoch"] == 1
+
+
+def test_recovery_budget_exhausted_raises(in_tmp):
+    nn, model, opt, samples = _fixture(num_epoch=2, k=1)
+    with pytest.raises(ElasticRecoveryError, match="max_recoveries"):
+        _run_elastic(
+            nn, model, opt, samples, None, "remesh_budget",
+            plan='[{"fault": "sigterm", "epoch": 0, "dispatch": 0}]',
+            controller=ElasticController(max_recoveries=0),
+        )
+
+
+def test_restart_fallback_returns_preempted_state(in_tmp):
+    """A layout with no in-process re-mesh equivalent takes the logged
+    restart-fallback POLICY: the driver returns the preempted state, the
+    controller records the decision, and the mid-epoch checkpoint is the
+    resume point for a relaunched job — tested single-device, where a
+    topology fault has no mesh to rebuild from."""
+    nn, model, opt, samples = _fixture(num_epoch=2, k=1)
+    res = Resilience.from_config(nn["Training"])
+    res.chaos = FaultPlan.parse(
+        '[{"fault": "mesh_shrink", "epoch": 1, "dispatch": 0, "to": 1}]'
+    )
+    ctl = ElasticController(devices=jax.devices()[:2])
+    tl, vl, sl = _loaders(samples)
+    state = train_elastic(
+        model, opt, _fresh_state(model, opt, samples, None), tl, vl, sl,
+        nn, "remesh_fb", verbosity=0, mesh=None, resilience=res,
+        controller=ctl,
+    )
+    assert ctl.state == "restart_fallback"
+    assert res.preempted  # classic semantics: checkpoint is the resume point
+    template = create_train_state(model, opt, next(iter(_loaders(samples)[0])))
+    _, meta = load_checkpoint(template, "remesh_fb")
+    assert meta["mid_epoch"]
+
+
+# -- resume-grid edge cases ---------------------------------------------------
+
+
+def test_epoch_boundary_resume_rolls_into_next_epoch(in_tmp):
+    """raw_batches_done == epoch length: everything in the interrupted
+    epoch is already trained — the resume rolls into the NEXT epoch, never
+    a zero-length tail (which would report the empty accumulator's 0.0 as
+    a genuine loss)."""
+    nn, model, opt, samples = _fixture(num_epoch=3, k=1)
+    res = Resilience.from_config(nn["Training"])
+    meta = {
+        "mid_epoch": True, "epoch": 1, "raw_batches_done": 12,
+        "steps_per_dispatch": 1, "n_dev": 1, "shuffle_seed": 0,
+    }
+    tl, vl, sl = _loaders(samples)
+    state = train_validate_test(
+        model, opt, _fresh_state(model, opt, samples, None), tl, vl, sl,
+        nn, "remesh_boundary", verbosity=0, resilience=res, resume_meta=meta,
+    )
+    assert res.resume_mode == "next_epoch"
+    assert "complete" in res.resume_reason
+    # only epoch 2 trained: 12 raw batches, not 12 + a zero-length tail
+    assert int(np.asarray(state.step)) == 12
+
+
+def test_loader_resume_point_at_boundary_warns_empty():
+    samples = deterministic_graph_data(number_configurations=8, seed=3)
+    loader = GraphLoader(samples, 2)
+    n = len(loader)
+    loader.set_resume_point(n)
+    with pytest.warns(UserWarning, match="already fully trained"):
+        plan = loader.batch_plan()
+    assert plan == []
+    assert len(loader.batch_plan()) == n  # one-shot: next epoch is full
+
+
+# -- checkpoint recovery-path hardening ---------------------------------------
+
+
+def _count_retry_sleeps(monkeypatch):
+    calls = []
+    from hydragnn_tpu.utils import retry as retry_mod
+
+    monkeypatch.setattr(
+        retry_mod.time, "sleep", lambda s: calls.append(s)
+    )
+    return calls
+
+
+def test_writer_killed_between_tempwrite_and_replace(in_tmp, monkeypatch):
+    """Regression (ISSUE 14 satellite): kill the writer between a sidecar's
+    temp-write and its ``os.replace``. The swap never happened, so the
+    previous 'latest' stays resumable and restore pays ZERO retry sleeps."""
+    nn, model, opt, samples = _fixture(num_epoch=1, k=1)
+    state = _fresh_state(model, opt, samples, None)
+    save_checkpoint(state, "ck_kill", 0, meta={"tag": "good"})
+
+    class WriterKilled(BaseException):
+        pass
+
+    real_replace = os.replace
+
+    def dying_replace(src, dst):
+        if dst.endswith(".manifest.json"):
+            raise WriterKilled()  # died with only the temp file written
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", dying_replace)
+    with pytest.raises(WriterKilled):
+        save_checkpoint(state, "ck_kill", 1, meta={"tag": "torn"})
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    sleeps = _count_retry_sleeps(monkeypatch)
+    restored, meta = load_checkpoint(state, "ck_kill")
+    # the epoch-1 payload exists but its manifest never swapped in and the
+    # pointer still names epoch_0 — the good checkpoint restores
+    assert meta.get("tag") == "good" and meta["epoch"] == 0
+    assert sleeps == []  # no retry budget consumed on the fallback walk
+
+
+def test_torn_manifest_falls_back_without_retry_budget(in_tmp, monkeypatch):
+    """A manifest that EXISTS but is torn (writer died mid-write in the
+    pre-atomic era / bit rot) is a permanent fault: restore walks to the
+    previous epoch immediately — zero backoff sleeps per torn manifest."""
+    nn, model, opt, samples = _fixture(num_epoch=1, k=1)
+    state = _fresh_state(model, opt, samples, None)
+    save_checkpoint(state, "ck_torn", 0, meta={"tag": "good"})
+    p1 = save_checkpoint(state, "ck_torn", 1, meta={"tag": "newest"})
+    with open(p1 + ".manifest.json", "w") as f:
+        f.write('{"treedef_sha256": "abc", "leaves": [')  # torn mid-write
+
+    sleeps = _count_retry_sleeps(monkeypatch)
+    with pytest.warns(UserWarning, match="fallback"):
+        restored, meta = load_checkpoint(state, "ck_torn")
+    assert meta.get("tag") == "good" and meta["epoch"] == 0
+    assert sleeps == []
+    # pinned restore of the torn epoch raises the typed corruption error
+    with pytest.raises(CheckpointCorruptError, match="torn"):
+        load_checkpoint(state, "ck_torn", epoch=1)
+    assert sleeps == []
+
+
+# -- population checkpoint / continue -----------------------------------------
+
+
+def test_population_template_roundtrip(in_tmp):
+    """Fast unit (ISSUE 14 satellite): the [N]-stacked template restores a
+    saved population bit-exactly — fp32 master weights, per-member opt
+    state incl. the injected lr stack, per-member step counters — and the
+    sidecar round-trips the member bookkeeping."""
+    from hydragnn_tpu.train.population import (
+        create_population_state,
+        population_meta,
+        population_template,
+        MemberTracker,
+    )
+
+    nn, model, opt, samples = _fixture(num_epoch=1, k=1)
+    example = next(iter(_loaders(samples)[0]))
+    pstate = create_population_state(
+        model, opt, example, 2, seeds=[0, 1],
+        hyperparams={"learning_rate": [1e-3, 3e-3]},
+    )
+    tracker = MemberTracker(2, 3)
+    tracker.push(np.asarray([[0, 1]]))
+    save_checkpoint(
+        pstate.state, "pop_rt", 0, meta=population_meta(2, 1, tracker)
+    )
+    template = population_template(model, opt, example, 2)
+    assert jax.tree_util.tree_structure(
+        template.state
+    ) == jax.tree_util.tree_structure(pstate.state)
+    restored, meta = load_checkpoint(template.state, "pop_rt")
+    _assert_bit_exact(pstate.state, restored)
+    # the injected per-member lr STACK rides the restored opt state
+    lrs = np.asarray(restored.opt_state.hyperparams["learning_rate"])
+    np.testing.assert_allclose(lrs, [1e-3, 3e-3])
+    assert meta["population"] == 2 and meta["population_epochs_done"] == 1
+    assert meta["member_tracker"]["total"] == [0, 1]
+    t2 = MemberTracker(2, 3)
+    t2.load_state_dict(meta["member_tracker"])
+    assert list(t2.total) == [0, 1] and list(t2.consecutive) == [0, 1]
+
+
+def test_population_size_mismatch_rejected(in_tmp):
+    from hydragnn_tpu.train.population import fit_population, stack_states
+
+    nn, model, opt, samples = _fixture(num_epoch=1, k=1)
+    example = next(iter(_loaders(samples)[0]))
+    s = create_train_state(model, opt, example)
+    bad = stack_states([s, s, s])  # 3-stack into a 2-member config
+    tl, vl, _ = _loaders(samples)
+    with pytest.raises(ValueError, match="3 members"):
+        fit_population(
+            model, opt, tl, vl, nn, n_members=2, initial_state=bad,
+        )
+
+
+@pytest.mark.slow
+def test_population_continue_bit_matches_uninterrupted(tmp_path, monkeypatch):
+    """ISSUE 14 acceptance: ``Training.continue`` + ``Training.population``
+    restores the stacked PopulationState and the resumed epochs bit-match
+    an uninterrupted population run (the run_training.py:111
+    NotImplementedError is gone)."""
+    monkeypatch.setenv("HYDRAGNN_VALTEST", "0")
+    from hydragnn_tpu.config import get_log_name_config
+    from hydragnn_tpu.run_training import run_training
+
+    def cfg_pop(num_epoch, cont=False, ckpt_every=False, startfrom=None):
+        cfg = copy.deepcopy(CI_CONFIG)
+        t = cfg["NeuralNetwork"]["Training"]
+        t["num_epoch"] = num_epoch
+        t["population"] = {"size": 2, "learning_rates": [1e-3, 3e-3]}
+        t["batch_size"] = 4
+        if cont:
+            t["continue"] = 1
+        if startfrom:
+            t["startfrom"] = startfrom
+        if ckpt_every:
+            t.setdefault("resilience", {})["checkpoint_every_epoch"] = True
+        return cfg
+
+    samples = deterministic_graph_data(number_configurations=24, seed=9)
+    d_ref, d_cut = tmp_path / "ref", tmp_path / "cut"
+    d_ref.mkdir(), d_cut.mkdir()
+    monkeypatch.chdir(d_ref)
+    pref, _, _ = run_training(cfg_pop(4), samples=samples)
+    monkeypatch.chdir(d_cut)
+    _, _, ccut = run_training(cfg_pop(2, ckpt_every=True), samples=samples)
+    pb, _, _ = run_training(
+        cfg_pop(4, cont=True, startfrom=get_log_name_config(ccut)),
+        samples=samples,
+    )
+    _assert_bit_exact(pref.state, pb.state)
+    assert int(np.asarray(pb.state.step).max()) == int(
+        np.asarray(pref.state.step).max()
+    )
