@@ -1268,6 +1268,145 @@ def bench_resilience_overhead(batch_size: int = 64, bench_steps: int = 30,
     }
 
 
+def bench_telemetry_overhead_ab(batch_size: int = 64, epochs_per_window: int = 3,
+                                windows: int = 8) -> dict:
+    """Unified-telemetry-plane A/B (ISSUE 15): the same prebuilt GIN train
+    step driven through ``train_epoch`` with the telemetry plane fully OFF
+    (``HYDRAGNN_TELEMETRY=0`` — registry no-ops, journal closed, trace
+    events dark) vs fully ON (registry + an open ``events.jsonl`` journal
+    + ``HYDRAGNN_TRACE_EVENTS=1`` trace recording + the per-epoch journal
+    record the epoch loop writes). Budget <2% like ``resilience_overhead``,
+    same ABBA paired-window discipline (``utils.abtest.abba_verdict``):
+    interleaved windows, per-window epoch batches through the SAME compiled
+    step program (telemetry never touches the step program — the cost under
+    test is pure host-side bookkeeping: span stack pushes, trace-event
+    appends, one line-buffered journal write per epoch, counter bumps).
+    Emits the enabled arm's journal-record and trace-event counts as
+    evidence the enabled path actually did the work being priced."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu import telemetry
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.graphs.batching import GraphLoader
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.train import (
+        create_train_state,
+        make_train_step,
+        select_optimizer,
+    )
+    from hydragnn_tpu.train.loop import train_epoch
+    from __graft_entry__ import FLAGSHIP_CONFIG
+
+    cfg = copy.deepcopy(FLAGSHIP_CONFIG)
+    cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] = 64
+    cfg["NeuralNetwork"]["Training"]["batch_size"] = batch_size
+    samples = make_qm9_like_samples(max(batch_size * 4, 256), seed=47)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    optimizer = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    loader = GraphLoader(samples, batch_size, shuffle=False)
+    step = make_train_step(model, optimizer)
+    first = jax.tree.map(jnp.asarray, next(iter(loader)))
+    state_off = create_train_state(model, optimizer, first)
+    state_on = create_train_state(model, optimizer, first)
+
+    tmp = tempfile.mkdtemp(prefix="bench-telemetry-")
+    prev = {k: os.environ.get(k)
+            for k in ("HYDRAGNN_TELEMETRY", "HYDRAGNN_TRACE_EVENTS")}
+
+    def arm_off() -> None:
+        telemetry.close_journal()
+        os.environ["HYDRAGNN_TELEMETRY"] = "0"
+        os.environ["HYDRAGNN_TRACE_EVENTS"] = "0"
+
+    def arm_on() -> None:
+        os.environ["HYDRAGNN_TELEMETRY"] = "1"
+        os.environ["HYDRAGNN_TRACE_EVENTS"] = "1"
+        if telemetry.active_journal() is None:
+            telemetry.open_journal("telemetry_bench", path=tmp)
+
+    def window(state, epoch0: int) -> tuple:
+        # the ENABLED path's real per-epoch work: context id + train_epoch's
+        # tracer spans/trace events + the epoch journal record + counters —
+        # exactly what train_validate_test adds per epoch, minus the
+        # val/test splits the resilience row also omits
+        t0 = time.perf_counter()
+        for e in range(epochs_per_window):
+            telemetry.set_context(epoch=epoch0 + e)
+            t_ep = time.perf_counter()
+            state, loss, _ = train_epoch(step, state, loader, verbosity=0)
+            telemetry.emit(
+                "epoch", epoch=epoch0 + e, train_loss=float(loss),
+                duration_s=time.perf_counter() - t_ep,
+                raw_batches=len(loader),
+            )
+            telemetry.counter("train_epochs_total").inc()
+        return state, time.perf_counter() - t0
+
+    telemetry.configure(None)  # env flags drive both arms
+    # a fresh trace buffer: earlier bench rows (run with trace events armed
+    # in the ambient env) would otherwise inflate the did-the-work evidence
+    # counts below — or, at the buffer cap, silence the enabled arm entirely
+    telemetry.reset_trace()
+    try:
+        # compile + settle both arms untimed (post-compile drift otherwise
+        # bills whichever arm ran second)
+        arm_off()
+        state_off, _ = window(state_off, 0)
+        arm_on()
+        state_on, _ = window(state_on, 0)
+        off_ms, on_ms = [], []
+        per_window_steps = epochs_per_window * len(loader)
+        ep = epochs_per_window
+        for w in range(max(windows, 1)):
+            if w % 2 == 0:
+                arm_off()
+                state_off, t_a = window(state_off, ep)
+                arm_on()
+                state_on, t_b = window(state_on, ep)
+            else:
+                arm_on()
+                state_on, t_b = window(state_on, ep)
+                arm_off()
+                state_off, t_a = window(state_off, ep)
+            ep += epochs_per_window
+            off_ms.append(1e3 * t_a / per_window_steps)
+            on_ms.append(1e3 * t_b / per_window_steps)
+        journal_path = os.path.join(tmp, "telemetry_bench", "events.jsonl")
+        n_records = len(telemetry.read_journal(journal_path))
+        n_trace = len(telemetry.trace_events())
+    finally:
+        telemetry.close_journal()
+        telemetry.reset_trace()
+        for key, val in prev.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+    overhead_pct, noise_pct, verdict = _abba_verdict(off_ms, on_ms,
+                                                     budget_pct=2.0)
+    return {
+        "workload": "telemetry_overhead",
+        "step_ms_disabled": round(statistics.median(off_ms), 3),
+        "step_ms_enabled": round(statistics.median(on_ms), 3),
+        "step_ms_disabled_windows": [round(x, 2) for x in off_ms],
+        "step_ms_enabled_windows": [round(x, 2) for x in on_ms],
+        "telemetry_overhead_pct": round(overhead_pct, 2),
+        "noise_pct": round(noise_pct, 2),
+        "budget_pct": 2.0,
+        "verdict": verdict,
+        "within_budget": verdict != "fail",
+        # proof the enabled arm did the work being priced
+        "journal_records": n_records,
+        "trace_events": n_trace,
+        "batch_size": batch_size,
+        "steps_per_window": epochs_per_window * len(loader),
+    }
+
+
 def bench_failover_recovery(n_samples: int = 192, batch: int = 16,
                             windows: int = 6) -> dict:
     """Elastic data-plane A/B (ISSUE 6): epoch time over a ShardedStore at
@@ -2065,6 +2204,9 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
     # ISSUE 14 row: in-process elastic recovery is CPU-provable by
     # construction (forced-host-device child), so the smoke carries it
     elastic_remesh = _row(bench_elastic_remesh_ab, 2)
+    # ISSUE 15 row: telemetry-plane overhead is pure host bookkeeping,
+    # CPU-provable by construction — the smoke carries the full A/B
+    telemetry_overhead = _row(bench_telemetry_overhead_ab, min(batch_size, 64), 2, 6)
     return {
         "workload": "cpu_smoke",
         "degraded": True,
@@ -2084,6 +2226,7 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
         "bf16_train_ab": bf16_ab,
         "autotune_ab": autotune_ab,
         "elastic_remesh_ab": elastic_remesh,
+        "telemetry_overhead_ab": telemetry_overhead,
     }
 
 
@@ -2896,6 +3039,11 @@ def child_main(status_path: str) -> None:
     # (recovery ms, zero lost samples, state agreement, ABBA overhead) —
     # CPU-provable via a forced-host-device child process
     plan.append(("elastic_remesh_ab", lambda: bench_elastic_remesh_ab()))
+    # ISSUE 15 acceptance row: the unified telemetry plane priced
+    # enabled-vs-disabled on the GIN canary (<2% budget, journal/trace
+    # record counts as did-the-work evidence) — CPU-provable by construction
+    plan.append(("telemetry_overhead_ab",
+                 lambda: bench_telemetry_overhead_ab(batch_size)))
     if os.getenv("BENCH_FUSED_AUTOTUNE", "1") != "0":
         # cheap kernel-only sweep BEFORE the compile-heavy arch entries, so
         # a short window still yields the tuning data it was added for

@@ -25,6 +25,15 @@ from typing import Any, Sequence
 
 import numpy as np
 
+# The top-level sections of the repo's JSON config schema — THE single
+# definition (block-or-full-config sniffers in serve/server.py and
+# telemetry/config.py import it, so a new section added here reaches every
+# consumer instead of drifting across hand-copied sets).
+CONFIG_SECTIONS = frozenset(
+    {"Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving",
+     "MD", "Telemetry"}
+)
+
 # Architectures grouped by capability (reference ``config_utils.py:64,179-206``).
 PNA_MODELS = ("PNA", "PNAPlus", "PNAEq")
 EDGE_MODELS = (
@@ -197,6 +206,29 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     for key, val in md_defaults.items():
         md_cfg.setdefault(key, val)
     MDConfig(**md_cfg).validate()  # one range-check implementation
+
+    # telemetry plane (hydragnn_tpu.telemetry): the top-level Telemetry
+    # block's defaults ARE the TelemetryConfig dataclass field defaults
+    # (same single-source pattern); HYDRAGNN_TELEMETRY /
+    # HYDRAGNN_TRACE_EVENTS env flags win at apply time (run_training folds
+    # them via TelemetryConfig.apply_env).
+    tel_cfg = config.setdefault("Telemetry", {})
+    if not isinstance(tel_cfg, dict):
+        raise ValueError(
+            f"Telemetry must be a dict, got {type(tel_cfg).__name__}"
+        )
+    from ..telemetry import TelemetryConfig, telemetry_config_defaults
+
+    tel_defaults = telemetry_config_defaults()
+    unknown_tel = set(tel_cfg) - set(tel_defaults)
+    if unknown_tel:
+        raise ValueError(
+            f"Unknown Telemetry key(s) {sorted(unknown_tel)}; known: "
+            f"{sorted(tel_defaults)}"
+        )
+    for key, val in tel_defaults.items():
+        tel_cfg.setdefault(key, val)
+    TelemetryConfig(**tel_cfg).validate()  # one range-check implementation
 
     # --- GPS / encoding defaults (reference :40-48) ---
     arch.setdefault("global_attn_engine", None)

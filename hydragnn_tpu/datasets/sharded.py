@@ -472,6 +472,14 @@ class ShardedStore:
         if announce:
             with self._lock:
                 self.quarantine_events += 1
+            from .. import telemetry as tel
+
+            tel.counter("store_quarantine_events_total").inc()
+            tel.emit(
+                "failover", peer=rank, host=host, port=port,
+                error=type(err).__name__,
+                has_replica=bool(failover),
+            )
             warnings.warn(
                 f"shard peer {host}:{port} (range [{s0}, {s1})) is down "
                 f"({type(err).__name__}: {err}): quarantined"
@@ -489,6 +497,28 @@ class ShardedStore:
                 f"again after {was['failures']} failed probe(s): quarantine "
                 "lifted"
             )
+
+    def stats(self) -> dict:
+        """The data plane's counters in the same shape the serve surfaces
+        use (and published through the same telemetry registry): remote /
+        failover fetch totals, peer-down events, cache occupancy, and the
+        current quarantine census — the operator's one-call health view of
+        the elastic store."""
+        with self._lock:
+            out = {
+                "remote_fetches": self.remote_fetches,
+                "failover_fetches": self.failover_fetches,
+                "quarantine_events": self.quarantine_events,
+                "cache_entries": len(self._cache),
+                "cache_size": self._cache_size,
+            }
+        with self._health_lock:
+            out["quarantined_peers"] = len(self._health)
+        out["peers"] = len(self.peers)
+        from .. import telemetry as tel
+
+        tel.publish("sharded_store", out)
+        return out
 
     def _replica_order(self, ranks) -> list[int]:
         """Failover order over a replica set: healthy peers first, rotated
@@ -641,6 +671,9 @@ class ShardedStore:
                     n = int(z.get("n", np.asarray(0)))
                     with self._lock:
                         self.failover_fetches += max(n, 0)
+                    from .. import telemetry as tel
+
+                    tel.counter("store_failover_fetches_total").inc(max(n, 0))
                 return z, rank, s0, s1
         raise ConnectionError(
             f"{what}: all {len(owner_ranks)} replica(s) failed after "
@@ -822,6 +855,9 @@ class ShardedStore:
             # its OWN copy (made before taking the lock) so later hits are
             # unaffected by whatever the caller does to this one
             cache_copies = [_copy_sample(s) for s in samples]
+            from .. import telemetry as tel
+
+            tel.counter("store_remote_fetches_total").inc(len(samples))
             with self._lock:
                 self.remote_fetches += len(samples)
                 for i, s, c in zip(idxs, samples, cache_copies):

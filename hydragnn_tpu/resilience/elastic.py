@@ -55,6 +55,8 @@ import sys
 import threading
 import time
 
+from .. import telemetry as tel
+
 
 class ElasticRecoveryError(RuntimeError):
     """In-process recovery is impossible (no survivors) or the recovery
@@ -115,6 +117,10 @@ class ElasticController:
         self._pending: list[Fault] = []  # guarded-by: _lock
         self.state = "running"  # guarded-by: _lock
         self.events: list[tuple] = []  # guarded-by: _lock ((t, what, detail))
+        # journal correlation id: assigned at the FIRST fault of a recovery
+        # (so the drain/checkpoint records it triggers already carry it),
+        # retired when the run re-enters "running"
+        self.recovery_id: str | None = None  # guarded-by: _lock
         self.recoveries = 0  # training thread only
         self.recovery_log: list[dict] = []  # training thread only
         self.max_recoveries = int(max_recoveries)
@@ -158,6 +164,24 @@ class ElasticController:
             self._pending.append(fault)
             self.state = "draining"
             self.events.append((fault.t_signal, "fault", fault.kind))
+            if self.recovery_id is None:
+                self.recovery_id = f"rec{self.recoveries + 1}"
+            # the ambient-context write happens INSIDE the same _lock hold
+            # as the id assignment (one-directional _lock -> context-lock
+            # edge, no cycle): otherwise a set_state("running") clearing
+            # the id on the training thread could interleave with this
+            # signal's deferred set and wipe the NEW recovery's id, losing
+            # the whole timeline's correlation. Every record from here
+            # through the resume carries this recovery_id.
+            tel.set_context(recovery_id=self.recovery_id)
+        tel.emit(
+            "fault", fault=fault.kind, device=fault.device,
+            count=fault.count, to=fault.to, detail=fault.detail or None,
+        )
+        # the state flip to "draining" happened under _lock above (not via
+        # set_state), so its phase record is emitted here
+        tel.emit("recovery_phase", phase="draining", detail=fault.kind)
+        tel.counter("elastic_faults_total", kind=fault.kind).inc()
         res = self.resilience
         if res is not None:
             # outside _lock: request_checkpoint touches the handler's own
@@ -179,6 +203,15 @@ class ElasticController:
         with self._lock:
             self.state = state
             self.events.append((time.monotonic(), state, detail))
+            if state == "running":
+                # healthy again: retire the correlation id so later records
+                # don't claim membership in a finished recovery. The
+                # context clear rides the SAME _lock hold as the id-null
+                # (see signal()): cleared outside it, a fault signaled in
+                # the release window would have its fresh id wiped.
+                self.recovery_id = None
+                tel.set_context(recovery_id=None)
+        tel.emit("recovery_phase", phase=state, detail=detail or None)
 
     # -- survivor bookkeeping (training thread, during recovery) --------------
     def survivors(self) -> list:
@@ -297,18 +330,22 @@ class ElasticController:
 
     def note_recovery(self, faults, mode: str, recovery_ms: float, meta: dict) -> None:
         over_budget = recovery_ms > 1e3 * self.recovery_budget_s
-        self.recovery_log.append(
-            {
-                "faults": [f.kind for f in faults],
-                "mode": mode,
-                "recovery_ms": float(recovery_ms),
-                "over_budget": over_budget,
-                "lost_indices": list(self.lost_indices()),
-                "resumed_epoch": meta.get("epoch"),
-                "raw_batches_done": meta.get("raw_batches_done"),
-                "logical_n_dev": meta.get("n_dev"),
-            }
-        )
+        entry = {
+            "faults": [f.kind for f in faults],
+            "mode": mode,
+            "recovery_ms": float(recovery_ms),
+            "over_budget": over_budget,
+            "lost_indices": list(self.lost_indices()),
+            "resumed_epoch": meta.get("epoch"),
+            "raw_batches_done": meta.get("raw_batches_done"),
+            "logical_n_dev": meta.get("n_dev"),
+        }
+        self.recovery_log.append(entry)
+        # the recovery_log, as a journal record: same fields, plus the
+        # ambient recovery_id/epoch correlation every journal record carries
+        tel.emit("recovery", **entry)
+        tel.counter("elastic_recoveries_total", mode=mode).inc()
+        tel.gauge("elastic_recovery_ms").set(float(recovery_ms))
         self.recoveries += 1
         if over_budget:
             import warnings

@@ -159,18 +159,35 @@ class SkipTracker:
             self._drain_one()
 
     def _drain_one(self) -> None:
+        from .. import telemetry as tel
+
         arr = np.atleast_1d(
             np.asarray(jax.device_get(self._pending.popleft()), np.int64)
         )
+        drained_skips = 0
         for s in arr:
             self.steps += 1
             if s:
                 self.total += 1
                 self.consecutive += 1
+                drained_skips += 1
             else:
                 self.consecutive = 0
+        if drained_skips:
+            # journal record per drained dispatch with skips (bounded by the
+            # streak limit before escalation takes over), so a post-mortem
+            # can see exactly WHICH steps the guard dropped
+            tel.emit(
+                "guard_skip", step=self.steps, skipped=drained_skips,
+                consecutive=self.consecutive, total=self.total,
+            )
+            tel.counter("guard_skipped_steps_total").inc(drained_skips)
         if 0 < self.max_consecutive <= self.consecutive:
             self._pending.clear()
+            tel.emit(
+                "divergence", consecutive=self.consecutive,
+                total=self.total, steps=self.steps,
+            )
             raise DivergenceDetected(
                 f"{self.consecutive} consecutive non-finite training steps "
                 f"were skipped ({self.total} of {self.steps} steps skipped "

@@ -117,7 +117,7 @@ class AnswerCache:
     def stats(self) -> dict:
         with self._lock:
             total = self.hits + self.misses
-            return {
+            out = {
                 "entries": len(self._entries),
                 "bytes": self.bytes,
                 "budget_bytes": self.budget_bytes,
@@ -128,6 +128,13 @@ class AnswerCache:
                 "evictions": self.evictions,
                 "oversize_skips": self.oversize_skips,
             }
+        # registry mirror OUTSIDE the lock (telemetry has its own locks;
+        # nesting them under ours would add a needless lock-order edge);
+        # the returned dict stays the test-pinned byte-compatible surface
+        from ... import telemetry as tel
+
+        tel.publish("fleet_cache", out)
+        return out
 
 
 __all__ = ["AnswerCache", "answer_key", "canonical_sample_bytes"]

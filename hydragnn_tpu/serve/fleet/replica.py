@@ -15,7 +15,11 @@ exposing three ops over the SAME transport the elastic data plane speaks:
 * ``stats`` — per-endpoint queue depth, shed counters, and the
   STEADY-LOWERING COUNT (jit lowerings since the replica advertised
   ready — 0 is the AOT zero-recompile guarantee, now provable per
-  replica across a process boundary) for routing/ops decisions.
+  replica across a process boundary) for routing/ops decisions;
+* ``metrics`` — the replica's full telemetry-registry snapshot
+  (``hydragnn_tpu.telemetry``) plus its stats dict, JSON over the wire;
+  ``FleetRouter.metrics()`` folds every replica's answer into the
+  fleet-wide aggregate view.
 
 ``worker_main`` is the subprocess entry (``python -m
 hydragnn_tpu.serve.fleet.replica spec.json``): it boots a
@@ -89,6 +93,11 @@ class ReplicaHost(wire.WireServer):
                 "n": np.asarray(0, np.int64),
                 "stats": wire.text_field(json.dumps(self.stats())),
             }
+        if "metrics" in z:
+            return {
+                "n": np.asarray(0, np.int64),
+                "metrics": wire.text_field(json.dumps(self.metrics())),
+            }
         if "predict" in z:
             return self._handle_predict(z)
         raise ValueError(f"unknown fleet op in frame keys {sorted(z)}")
@@ -131,6 +140,15 @@ class ReplicaHost(wire.WireServer):
             "steady_lowerings": int(compile_counts()["lowerings"])
             - self._ready_lowerings,
         }
+
+    def metrics(self) -> dict:
+        """The ``metrics`` wire op's payload: the replica process's whole
+        telemetry registry (``stats()`` first, so derived gauges are
+        fresh) plus the stats dict the aggregate row sums."""
+        from ... import telemetry as tel
+
+        stats = self.stats()  # publishes the serve gauges as a side effect
+        return {"stats": stats, "registry": tel.snapshot()}
 
 
 # -- subprocess worker --------------------------------------------------------

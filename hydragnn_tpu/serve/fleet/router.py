@@ -43,6 +43,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 
 import numpy as np
 
+from ... import telemetry as tel
 from ...utils import wire
 from ...utils.retry import RetryPolicy
 from .. import admission
@@ -283,17 +284,24 @@ class FleetRouter:
                         "cached": True,
                     })
                 return req.future
+        shed_full = False
         with self._work:
             q = self._queues[priority]
             if len(q) >= self.cfg.budget(priority):
                 self.counters[f"shed_{priority}"] += 1
                 self.counters["shed"] += 1
-                raise QueueFullError(
-                    f"{priority} class at budget "
-                    f"({self.cfg.budget(priority)}); request shed"
-                )
-            q.append(req)
-            self._work.notify_all()
+                shed_full = True
+            else:
+                q.append(req)
+                self._work.notify_all()
+        if shed_full:
+            tel.counter("fleet_requests", event=f"shed_{priority}").inc()
+            tel.counter("fleet_requests", event="shed").inc()
+            tel.emit("shed", **{"class": priority, "reason": "queue_full"})
+            raise QueueFullError(
+                f"{priority} class at budget "
+                f"({self.cfg.budget(priority)}); request shed"
+            )
         return req.future
 
     def predict(self, model: str, samples, priority: str = "interactive",
@@ -308,6 +316,9 @@ class FleetRouter:
     def _count(self, key: str, by: int = 1) -> None:
         with self._work:
             self.counters[key] += by
+        # dual-write into the unified registry (the dict stays the
+        # test-pinned stats() surface; the labeled series feed metrics())
+        tel.counter("fleet_requests", event=key).inc(by)
 
     # -- dispatch -----------------------------------------------------------
 
@@ -375,6 +386,10 @@ class FleetRouter:
             )):
                 self._count("shed_deadline")
                 self._count("shed")
+                tel.emit(
+                    "shed", **{"class": req.priority}, model=req.model,
+                    reason="deadline",
+                )
             else:
                 self._count("cancelled")
 
@@ -565,6 +580,7 @@ class FleetRouter:
             else:
                 self._count("cancelled")
             return
+        requeued = False
         with self._work:
             if self._stopping:
                 # stop() already drained (or is draining) the queues: fail
@@ -575,14 +591,20 @@ class FleetRouter:
                 q = self._queues[req.priority]
                 q.appendleft(req) if head else q.append(req)
                 self._work.notify_all()
-                return
+                requeued = True
+        if requeued:
+            tel.counter("fleet_requests", event="requeues").inc()
+            return
         if req.reject(ServerClosedError(
             "router stopped while the request was failing over"
         )):
             self._count("cancelled")
 
     def _count_locked(self, key: str, by: int = 1) -> None:
-        self.counters[key] += by  # caller holds _work
+        # caller holds _work — the registry dual-write happens at the
+        # caller AFTER release (nesting the telemetry locks under _work
+        # would add the exact lock-order edge cache.py documents avoiding)
+        self.counters[key] += by
 
     def _mark_replica_down(self, replica: _Replica, err: BaseException) -> None:
         fresh = self._health.bump(replica.rank)
@@ -590,6 +612,12 @@ class FleetRouter:
         with self._work:
             replica.failures += 1
             self.counters["failovers"] += 1
+        tel.counter("fleet_requests", event="failovers").inc()
+        tel.emit(
+            "failover", replica=replica.rank, host=replica.host,
+            port=replica.port, error=type(err).__name__,
+            fresh_quarantine=bool(fresh),
+        )
         if fresh:
             warnings.warn(
                 f"fleet replica {replica.rank} ({replica.host}:"
@@ -651,6 +679,7 @@ class FleetRouter:
                     self._health.bump(rank)
                     continue
                 if self._health.lift(rank) is not None:
+                    tel.emit("quarantine_lifted", replica=rank)
                     warnings.warn(
                         f"fleet replica {rank} ({replica.host}:"
                         f"{replica.port}) answers again: quarantine lifted"
@@ -701,7 +730,66 @@ class FleetRouter:
         c["queue_depths"] = depths
         c["replicas"] = replicas
         c["cache"] = self.cache.stats()
+        # registry mirror (counters dual-write at their increment sites)
+        tel.publish("fleet", c)
+        for cls, depth in depths.items():
+            tel.gauge("fleet_queue_depth", **{"class": cls}).set(depth)
         return c
+
+    def replica_metrics(self, rank: int) -> dict:
+        """One replica's ``metrics`` wire op, decoded: its full telemetry
+        registry snapshot plus its stats dict — the per-process view the
+        fleet-wide aggregation below folds together."""
+        r = self._replicas[rank]
+        z = self._rt.round_trip(
+            (r.host, r.port), r.host, r.port, policy=_ONE_ATTEMPT,
+            what=f"fleet metrics of replica {rank}",
+            metrics=np.asarray(1, np.int64),
+        )
+        self._check_protocol(z, r.host, r.port)
+        return json.loads(wire.field_text(z["metrics"]))
+
+    def metrics(self) -> dict:
+        """The fleet-wide telemetry view: the router's own stats + registry
+        snapshot, every reachable replica's ``metrics`` wire-op answer, and
+        an aggregate row (total queue depth, shed/served counts, steady
+        lowerings, cache hit-rate) — the one dict an operator (or the bench
+        harness) reads to answer "how is the fleet doing". Quarantined or
+        unreachable replicas report an ``error`` entry instead of hanging
+        the aggregation."""
+        out: dict = {
+            "router": self.stats(),
+            "registry": tel.snapshot(),
+            "replicas": {},
+        }
+        agg = {
+            "replicas_total": len(self._replicas),
+            "replicas_reporting": 0,
+            "queue_depth": 0,
+            "shed": 0,
+            "served": 0,
+            "steady_lowerings": 0,
+        }
+        for r in list(self._replicas):
+            if self._health.quarantined(r.rank):
+                out["replicas"][str(r.rank)] = {"error": "quarantined"}
+                continue
+            try:
+                m = self.replica_metrics(r.rank)
+            except (ConnectionError, OSError, RuntimeError) as e:
+                out["replicas"][str(r.rank)] = {
+                    "error": f"{type(e).__name__}: {e}"
+                }
+                continue
+            out["replicas"][str(r.rank)] = m
+            stats = m.get("stats", {})
+            agg["replicas_reporting"] += 1
+            for key in ("queue_depth", "shed", "served", "steady_lowerings"):
+                agg[key] += int(stats.get(key, 0) or 0)
+        agg["cache_hit_rate"] = out["router"]["cache"].get("hit_rate")
+        out["aggregate"] = agg
+        tel.publish("fleet_aggregate", agg)
+        return out
 
 
 __all__ = ["FleetRouter", "RoutedRequest"]

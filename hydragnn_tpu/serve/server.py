@@ -39,6 +39,7 @@ from ..graphs.graph import GraphSample
 _EMPTY = np.zeros((0,), np.int32)  # triplet default for extras-less samples
 from ..train.step import TrainState
 from ..utils import flags
+from .. import telemetry as tel
 from .admission import (
     DeadlineExceededError,
     IncompatibleSampleError,
@@ -54,10 +55,8 @@ from .predictor import Predictor
 
 # top-level sections of the repo's JSON config schema — lets from_config
 # tell "full config without a Serving block" (defaults) apart from "typo'd
-# serving block" (raise)
-_CONFIG_SECTIONS = frozenset(
-    {"Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving", "MD"}
-)
+# serving block" (raise); single-sourced from config/schema.py
+from ..config.schema import CONFIG_SECTIONS as _CONFIG_SECTIONS
 
 
 @dataclasses.dataclass
@@ -248,10 +247,16 @@ class ModelEndpoint:
         # "cancelled" = the client cancelled before the batcher could shed;
         # still a terminal outcome the submitted-total must account for
         self._count("cancelled" if kind == "cancelled" else f"shed_{kind}")
+        if kind != "cancelled":
+            tel.emit("shed", model=self.name, reason=kind)
 
     def _count(self, key: str, by: int = 1) -> None:
         with self._lock:
             self.counters[key] += by
+        # dual-write into the unified registry: the dict above stays the
+        # test-pinned stats() surface; the labeled counter is what the
+        # fleet `metrics` wire op and the CLI read
+        tel.counter("serve_requests", model=self.name, event=key).inc(by)
 
     @staticmethod
     def _signature(s: GraphSample) -> dict:
@@ -402,6 +407,11 @@ class ModelEndpoint:
                 "(quantize=false) or raise quant_tol if the error is "
                 "acceptable for this model"
             )
+        tel.emit(
+            "quant_cert", model=self.name,
+            bounds=[round(b, 6) for b in bounds],
+            quant_tol=self.cfg.quant_tol, buckets=len(self.buckets),
+        )
         return report
 
     def _step_for(self, pad: PadSpec):
@@ -612,6 +622,10 @@ class PredictionServer:
             name: ep.warm(verify=verify) for name, ep in self._models.items()
         }
         report["total_s"] = round(time.perf_counter() - t0, 4)
+        tel.emit(
+            "serve_warmup", models=sorted(self._models),
+            total_s=report["total_s"],
+        )
         return report
 
     # -- lifecycle ----------------------------------------------------------
@@ -708,8 +722,9 @@ class PredictionServer:
             # stats() sees misrouted traffic, not just backpressure
             ep.check_sample(sample)
             ep.queue.put(req)
-        except Exception:
+        except Exception as exc:
             ep._count("shed")
+            tel.emit("shed", model=model, reason=type(exc).__name__)
             raise
         return req.future
 
@@ -739,6 +754,9 @@ class PredictionServer:
             c["occupancy"] = round(
                 c["real_graph_slots"] / c["graph_slots"], 4
             ) if c["graph_slots"] else None
+            # registry mirror of the derived values (counters dual-write at
+            # their increment sites); the dict itself stays byte-compatible
+            tel.publish("serve", c, model=name)
             out[name] = c
         return out
 

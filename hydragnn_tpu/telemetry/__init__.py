@@ -1,0 +1,116 @@
+"""The unified telemetry plane: metrics registry + event journal + traces.
+
+One queryable source of truth over the whole train -> serve -> recover
+stack (the reference's ``profiling_and_tracing`` plugin registry, rebuilt
+as three coherent surfaces instead of five ad-hoc ``stats()`` dicts):
+
+* :mod:`~hydragnn_tpu.telemetry.metrics` — thread-safe typed
+  Counter/Gauge/Histogram registry with label sets; ``snapshot()`` is the
+  stable dict the fleet ``metrics`` wire op ships;
+* :mod:`~hydragnn_tpu.telemetry.journal` — the append-only structured
+  event journal (``logs/<run>/events.jsonl``): one schema'd record per
+  epoch / dispatch block / guard skip / rollback / recovery phase /
+  failover / shed, each carrying monotonic seq + wall time + correlation
+  ids (run_id/epoch/step/recovery_id);
+* :mod:`~hydragnn_tpu.telemetry.trace` — Chrome trace-event export of the
+  tracer's nested spans (perfetto-loadable ``trace.json``), tagged with
+  the same correlation ids;
+* ``python -m hydragnn_tpu.telemetry <events.jsonl>`` — the post-mortem
+  CLI (:mod:`~hydragnn_tpu.telemetry.cli`).
+
+``HYDRAGNN_TELEMETRY=0`` turns the whole plane into near-zero-cost no-ops;
+``HYDRAGNN_TRACE_EVENTS=1`` (or ``Telemetry.trace_events``) additionally
+records the span timeline. :func:`configure` applies a validated
+``Telemetry`` config block process-wide (env flags still win, folded in by
+``TelemetryConfig.apply_env``).
+"""
+
+from __future__ import annotations
+
+from .config import TelemetryConfig, telemetry_config_defaults
+from .journal import (
+    EventJournal,
+    active_journal,
+    clear_context,
+    close_journal,
+    emit,
+    get_context,
+    open_journal,
+    read_journal,
+    set_context,
+)
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NOOP,
+    REGISTRY,
+    counter,
+    enabled,
+    gauge,
+    histogram,
+    publish,
+    reset_metrics,
+    set_enabled,
+    snapshot,
+)
+from .trace import (
+    add_span,
+    reset_trace,
+    save_trace,
+    set_trace_enabled,
+    trace_enabled,
+    trace_events,
+)
+
+
+def configure(cfg: "TelemetryConfig | dict | None") -> "TelemetryConfig | None":
+    """Apply a ``Telemetry`` config block process-wide (``None`` resets
+    both overrides to follow the env flags). Returns the applied config."""
+    if cfg is None:
+        set_enabled(None)
+        set_trace_enabled(None)
+        return None
+    if not isinstance(cfg, TelemetryConfig):
+        cfg = TelemetryConfig.from_config(cfg)
+    cfg.validate()
+    set_enabled(cfg.enabled)
+    set_trace_enabled(cfg.trace_events)
+    return cfg
+
+
+__all__ = [
+    "Counter",
+    "EventJournal",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NOOP",
+    "REGISTRY",
+    "TelemetryConfig",
+    "active_journal",
+    "add_span",
+    "clear_context",
+    "close_journal",
+    "configure",
+    "counter",
+    "emit",
+    "enabled",
+    "gauge",
+    "get_context",
+    "histogram",
+    "open_journal",
+    "publish",
+    "read_journal",
+    "reset_metrics",
+    "reset_trace",
+    "save_trace",
+    "set_context",
+    "set_enabled",
+    "set_trace_enabled",
+    "snapshot",
+    "telemetry_config_defaults",
+    "trace_enabled",
+    "trace_events",
+]

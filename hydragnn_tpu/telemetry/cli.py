@@ -1,0 +1,235 @@
+"""``python -m hydragnn_tpu.telemetry <events.jsonl>`` — the post-mortem
+timeline renderer.
+
+Turns a run's structured event journal (plus, when present, its
+``trace.json``) into the human answer to "what happened": a chronological
+event timeline, every elastic recovery reconstructed phase-by-phase from
+its ``recovery_id``-correlated records (fault -> drain -> checkpoint ->
+re-mesh -> resume), shed/failover totals, per-epoch throughput, and the
+top aggregate spans. Pure stdlib + file reads — it must work on a login
+node over the logs of a crashed job.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from collections import defaultdict
+
+from .journal import read_journal
+
+
+def _fmt_t(rec: dict, t0: float) -> str:
+    return f"+{max(rec.get('t_wall', t0) - t0, 0.0):9.3f}s"
+
+
+def _fields(rec: dict, skip=("kind", "t_wall", "seq", "run_id")) -> str:
+    parts = []
+    for key in sorted(rec):
+        if key in skip:
+            continue
+        value = rec[key]
+        if isinstance(value, float):
+            value = round(value, 6)
+        parts.append(f"{key}={value}")
+    return " ".join(parts)
+
+
+def render_timeline(records: list[dict], limit: int = 200) -> str:
+    if not records:
+        return "timeline: no records"
+    t0 = records[0].get("t_wall", 0.0)
+    lines = [f"timeline ({len(records)} records):"]
+    shown = records if len(records) <= limit else records[-limit:]
+    if len(records) > limit:
+        lines.append(f"  ... {len(records) - limit} earlier records elided "
+                     "(--full shows everything)")
+    for rec in shown:
+        lines.append(
+            f"  {_fmt_t(rec, t0)}  {rec.get('kind', '?'):<18} {_fields(rec)}"
+        )
+    return "\n".join(lines)
+
+
+def render_recoveries(records: list[dict]) -> str:
+    by_id: dict = defaultdict(list)
+    for rec in records:
+        rid = rec.get("recovery_id")
+        if rid is not None:
+            by_id[rid].append(rec)
+    if not by_id:
+        return "recoveries: none"
+    lines = [f"recoveries ({len(by_id)}):"]
+    for rid in sorted(by_id):
+        phase_recs = by_id[rid]
+        t0 = phase_recs[0].get("t_wall", 0.0)
+        summary = next(
+            (r for r in phase_recs if r.get("kind") == "recovery"), None
+        )
+        head = f"  {rid}:"
+        if summary is not None:
+            head += (
+                f" mode={summary.get('mode')} "
+                f"recovery_ms={round(float(summary.get('recovery_ms', 0)), 1)} "
+                f"faults={summary.get('faults')}"
+            )
+        lines.append(head)
+        for rec in phase_recs:
+            kind = rec.get("kind")
+            if kind == "recovery_phase":
+                what = f"phase {rec.get('phase')}"
+                if rec.get("detail"):
+                    what += f" ({rec['detail']})"
+            elif kind == "recovery":
+                continue  # already on the header line
+            else:
+                what = f"{kind} {_fields(rec, skip=('kind', 't_wall', 'seq', 'run_id', 'recovery_id'))}"
+            lines.append(f"    {_fmt_t(rec, t0)}  {what}")
+    return "\n".join(lines)
+
+
+def render_epochs(records: list[dict]) -> str:
+    epochs = [r for r in records if r.get("kind") == "epoch"]
+    if not epochs:
+        return "epochs: none recorded"
+    lines = ["epoch throughput:"]
+    for rec in epochs:
+        dur = float(rec.get("duration_s") or 0.0)
+        raw = rec.get("raw_batches")
+        rate = (
+            f"{raw / dur:8.1f} batches/s" if raw and dur > 0 else "        -"
+        )
+        loss = rec.get("train_loss")
+        loss_s = f"{loss:.6f}" if isinstance(loss, (int, float)) else "nan"
+        lines.append(
+            f"  epoch {rec.get('epoch', '?'):>4}: loss {loss_s}  "
+            f"{dur:7.2f}s  {rate}"
+            + (f"  val {rec['val_loss']:.6f}"
+               if isinstance(rec.get("val_loss"), (int, float)) else "")
+        )
+    return "\n".join(lines)
+
+
+def render_sheds(records: list[dict]) -> str:
+    sheds = [r for r in records if r.get("kind") == "shed"]
+    fails = [r for r in records if r.get("kind") == "failover"]
+    if not sheds and not fails:
+        return "sheds/failovers: none"
+    by_reason: dict = defaultdict(int)
+    for rec in sheds:
+        key = (rec.get("model") or rec.get("class") or "?", rec.get("reason", "?"))
+        by_reason[key] += 1
+    lines = [f"sheds ({len(sheds)}) / failovers ({len(fails)}):"]
+    for (who, reason), n in sorted(by_reason.items()):
+        lines.append(f"  shed {who} [{reason}]: {n}")
+    for rec in fails:
+        lines.append(
+            f"  failover replica={rec.get('replica', rec.get('peer', '?'))} "
+            f"error={rec.get('error', '?')}"
+        )
+    return "\n".join(lines)
+
+
+def render_top_spans(trace_path: str | None, top: int = 10) -> str:
+    if not trace_path or not os.path.exists(trace_path):
+        return "top spans: no trace.json"
+    with open(trace_path) as f:
+        doc = json.load(f)
+    # both Chrome trace forms load: the object form ({"traceEvents": [...]})
+    # our writer emits, and the equally valid bare-array form
+    events = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+    agg: dict = defaultdict(lambda: [0, 0.0])
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        entry = agg[ev.get("name", "?")]
+        entry[0] += 1
+        entry[1] += float(ev.get("dur", 0.0)) / 1e6
+    if not agg:
+        return "top spans: trace has no complete events"
+    lines = [f"top spans ({os.path.basename(trace_path)}):"]
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top]
+    for name, (count, total) in ranked:
+        lines.append(
+            f"  {name:<24} total {total:9.3f}s over {count:6d} span(s) "
+            f"(avg {1e3 * total / max(count, 1):8.2f} ms)"
+        )
+    return "\n".join(lines)
+
+
+def render_report(records: list[dict], trace_path: str | None = None,
+                  full: bool = False) -> str:
+    run_id = next(
+        (r["run_id"] for r in records if "run_id" in r), "<unknown>"
+    )
+    parts = [
+        f"telemetry report — run {run_id}, {len(records)} journal record(s)",
+        "",
+        render_recoveries(records),
+        "",
+        render_epochs(records),
+        "",
+        render_sheds(records),
+        "",
+        render_top_spans(trace_path),
+        "",
+        render_timeline(records, limit=10**9 if full else 200),
+    ]
+    return "\n".join(parts)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.telemetry",
+        description="Render a run's events.jsonl (and trace.json) into a "
+                    "human timeline: recoveries, sheds, epoch throughput, "
+                    "top spans.",
+    )
+    parser.add_argument(
+        "events",
+        help="path to an events.jsonl, or a run log dir containing one",
+    )
+    parser.add_argument(
+        "--trace", default=None,
+        help="trace.json for the top-spans section (default: the "
+             "events file's sibling trace.json, when present)",
+    )
+    parser.add_argument(
+        "--full", action="store_true",
+        help="print every timeline record (default caps at 200)",
+    )
+    args = parser.parse_args(argv)
+    events_path = args.events
+    if os.path.isdir(events_path):
+        events_path = os.path.join(events_path, "events.jsonl")
+    if not os.path.exists(events_path):
+        parser.error(f"no events journal at {events_path}")
+    trace_path = args.trace
+    if trace_path is None:
+        sibling = os.path.join(os.path.dirname(events_path), "trace.json")
+        trace_path = sibling if os.path.exists(sibling) else None
+    records = read_journal(events_path)
+    try:
+        print(render_report(records, trace_path=trace_path, full=args.full))
+    except BrokenPipeError:
+        # `... | head` closed the pipe: normal operator behavior, not an
+        # error worth a traceback
+        import sys
+
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+    return 0
+
+
+__all__ = [
+    "main",
+    "render_epochs",
+    "render_recoveries",
+    "render_report",
+    "render_sheds",
+    "render_timeline",
+    "render_top_spans",
+]

@@ -25,6 +25,7 @@ from ..models.base import HydraModel
 from ..utils.print_utils import print_distributed, iterate_tqdm
 from ..utils import flags
 from ..utils import tracer as tr
+from .. import telemetry as tel
 from .checkpoint import Checkpoint, EarlyStopping, save_checkpoint
 from .optimizer import ReduceLROnPlateau, get_learning_rate, set_learning_rate
 from .step import (
@@ -343,6 +344,14 @@ def train_epoch(
                 dispatches += 1
                 with wd("train step sync (backpressure)"):
                     _backpressure(step_metrics)
+            if k > 1:
+                # one journal record per superstep BLOCK (the dispatch
+                # granularity): K=1 epochs summarize in the epoch record
+                # instead of paying a write per batch
+                tel.emit(
+                    "dispatch_block", block=ib, step=ib * per_dispatch,
+                    k=k, n_dev=n_dev,
+                )
             if tracker is not None and "skipped" in metrics:
                 # deferred read: only values the backpressure window already
                 # waited for are materialized, so tracking never stalls the
@@ -459,6 +468,11 @@ def _rollback_state(state, log_name, res, rollbacks, err, verbosity):
     old_lr = get_learning_rate(good.opt_state)
     new_lr = old_lr * res.rollback_lr_factor ** rollbacks
     good = good._replace(opt_state=set_learning_rate(good.opt_state, new_lr))
+    tel.emit(
+        "rollback", restored_epoch=meta.get("epoch"), consecutive=rollbacks,
+        lr_old=float(old_lr), lr_new=float(new_lr), cause=str(err)[:256],
+    )
+    tel.counter("divergence_rollbacks_total").inc()
     print_distributed(
         verbosity,
         f"divergence rollback #{rollbacks}: restored checkpoint from epoch "
@@ -856,6 +870,15 @@ def train_validate_test(
         if sentinel_mode is None:
             return
         delta = compile_counts()["lowerings"] - lowerings_at_epoch_start
+        if delta:
+            # the sentinel's lowering counts land in the journal either way:
+            # a warm-up compile is expected context, a steady-state one is
+            # the anomaly the modes below warn/abort on
+            tel.emit(
+                "compile_sentinel", epoch=epoch, new_lowerings=int(delta),
+                warmup=epoch <= sentinel_warmup_through,
+            )
+            tel.gauge("compile_lowerings_delta").set(int(delta))
         # warm-up = the FIRST epoch this process executes (start_epoch > 0
         # after a mid-run resume: that epoch compiles everything fresh) —
         # and, after a PARTIAL mid-epoch resume, also the first full epoch
@@ -913,10 +936,33 @@ def train_validate_test(
             ),
         )
         res.preempted = True
+        tel.emit(
+            "preempt_checkpoint", epoch=epoch + 1, raw_done=0,
+            mid_epoch=False,
+        )
         print_distributed(
             verbosity, f"Preemption requested: checkpointed after epoch {epoch}"
         )
         return True
+
+    def _journal_epoch(epoch: int, t0: float, train_loss, val_loss=None,
+                       test_loss=None) -> None:
+        """One journal record + registry publish per finished epoch — the
+        timeline row the CLI's throughput section reads."""
+        record = {
+            "train_loss": _finite_or_none(train_loss),
+            "duration_s": round(time.monotonic() - t0, 4),
+            "raw_batches": int(res.epoch_raw_done),
+            "skipped": int(res.skipped_total),
+            "lr": float(get_learning_rate(state.opt_state)),
+        }
+        if val_loss is not None:
+            record["val_loss"] = _finite_or_none(val_loss)
+        if test_loss is not None:
+            record["test_loss"] = _finite_or_none(test_loss)
+        tel.emit("epoch", epoch=epoch, **record)
+        tel.counter("train_epochs_total").inc()
+        tel.publish("train", record)
 
     res.install()  # SIGTERM/SIGUSR1 -> checkpoint request (restored below)
     rollbacks = 0
@@ -924,6 +970,8 @@ def train_validate_test(
     try:
         while epoch < num_epoch:
             os.environ["HYDRAGNN_EPOCH"] = str(epoch)  # exported for tools (reference :316)
+            tel.set_context(epoch=epoch)  # correlation id on every record
+            t_epoch0 = time.monotonic()
             if sentinel_mode is not None:
                 lowerings_at_epoch_start = compile_counts()["lowerings"]
             train_loader.set_epoch(epoch)
@@ -1016,6 +1064,10 @@ def train_validate_test(
                     ),
                 )
                 res.preempted = True
+                tel.emit(
+                    "preempt_checkpoint", epoch=epoch, raw_done=raw_done,
+                    raw_total=raw_total, mid_epoch=True,
+                )
                 print_distributed(
                     verbosity,
                     f"Preemption requested: checkpointed mid-epoch at epoch "
@@ -1027,6 +1079,7 @@ def train_validate_test(
                 print_distributed(
                     verbosity, f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}"
                 )
+                _journal_epoch(epoch, t_epoch0, train_loss)
                 if writer is not None:
                     writer.add_scalar("train error", train_loss, epoch)
                 # checkpoint on train loss and honor the walltime guard even
@@ -1063,6 +1116,7 @@ def train_validate_test(
                 f"Epoch: {epoch:04d}, Train Loss: {train_loss:.8f}, "
                 f"Val Loss: {val_loss:.8f}, Test Loss: {test_loss:.8f}, LR: {new_lr:.2e}",
             )
+            _journal_epoch(epoch, t_epoch0, train_loss, val_loss, test_loss)
             if writer is not None:
                 writer.add_scalar("train error", train_loss, epoch)
                 writer.add_scalar("validate error", val_loss, epoch)

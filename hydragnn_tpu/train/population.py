@@ -46,6 +46,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import time
 from typing import Any, Callable, NamedTuple, Sequence
 
 import jax
@@ -427,6 +428,7 @@ def fit_population(
     start_epoch: int = 0,
     tracker_state: dict | None = None,
     log_name: str | None = None,
+    path: str = "./logs/",
 ) -> tuple[PopulationState, dict]:
     """The population engine: train N members as one vmapped (and, at
     ``Training.steps_per_dispatch``/``HYDRAGNN_SUPERSTEP`` K>1,
@@ -526,16 +528,20 @@ def fit_population(
         from .checkpoint import save_checkpoint
 
         save_checkpoint(
-            pstate.state, log_name, epoch,
+            pstate.state, log_name, epoch, path=path,
             meta=population_meta(n, epoch + 1, tracker),
         )
 
     train_loss = np.full(n, np.nan)
     val_loss = np.full(n, np.nan)
     history = []
+    from .. import telemetry as tel
+
     for epoch in range(start_epoch, num_epoch):
         train_loader.set_epoch(epoch)
         hooks.current_epoch = epoch
+        tel.set_context(epoch=epoch)
+        t_epoch0 = time.monotonic()
         pstate, train_loss, _ = train_epoch(
             dispatch_step, pstate, train_loader, verbosity,
             steps_per_dispatch=k, resilience=hooks, accumulate=acc,
@@ -552,6 +558,24 @@ def fit_population(
                 "train_loss": [float(x) for x in np.asarray(train_loss)],
                 "val_loss": [float(x) for x in np.asarray(val_loss)],
             }
+        )
+        # scalar headline losses (finite-member mean) so the CLI's
+        # epoch-throughput section renders population runs too; the
+        # per-member vectors ride alongside under member_* keys
+        def _finite_mean(xs):
+            finite_xs = [x for x in np.asarray(xs, np.float64) if np.isfinite(x)]
+            return float(np.mean(finite_xs)) if finite_xs else None
+
+        tel.emit(
+            "epoch", epoch=epoch, members=n,
+            duration_s=round(time.monotonic() - t_epoch0, 4),
+            raw_batches=int(getattr(hooks, "epoch_raw_done", 0) or 0),
+            train_loss=_finite_mean(train_loss),
+            val_loss=None if skip_valtest else _finite_mean(val_loss),
+            member_train_loss=history[-1]["train_loss"],
+            member_val_loss=(
+                None if skip_valtest else history[-1]["val_loss"]
+            ),
         )
         _fmt = lambda xs: "[" + ", ".join(f"{x:.6f}" for x in np.asarray(xs)) + "]"
         print_distributed(
@@ -625,12 +649,15 @@ def train_population(
     initial_state: PopulationState | None = None,
     start_epoch: int = 0,
     tracker_state: dict | None = None,
+    path: str = "./logs/",
 ) -> tuple[PopulationState, dict]:
     """Config-driven front of :func:`fit_population`: reads the
     ``Training.population`` block (size / per-member seeds, learning rates,
     weight decays, task weights), trains the population, evaluates the test
     split per member, and writes the summary next to the run logs
-    (``logs/<run>/population.json``). ``initial_state``/``start_epoch``/
+    (``<path>/<run>/population.json`` — the same ``path=`` root
+    ``checkpoint.py`` threads everywhere, so a relocated log tree relocates
+    the summary with it). ``initial_state``/``start_epoch``/
     ``tracker_state`` are the ``Training.continue`` resume point
     (``run_training`` restores them via :func:`population_template` + the
     checkpoint sidecar's :func:`population_meta` block)."""
@@ -655,6 +682,7 @@ def train_population(
         start_epoch=start_epoch,
         tracker_state=tracker_state,
         log_name=log_name,
+        path=path,
     )
     from ..utils import flags
     from .loop import evaluate
@@ -669,9 +697,12 @@ def train_population(
         summary["test_loss"] = [float(x) for x in np.asarray(test_loss)]
         summary["test_rmse"] = np.asarray(test_rmse).tolist()
     try:
-        path = os.path.join("./logs", log_name, "population.json")
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w") as f:
+        # the configurable path= root, NOT a hardcoded "./logs" — the
+        # summary must land next to the run's checkpoints wherever the
+        # caller pointed the log tree
+        summary_path = os.path.join(path, log_name, "population.json")
+        os.makedirs(os.path.dirname(summary_path), exist_ok=True)
+        with open(summary_path, "w") as f:
             json.dump(summary, f, indent=2)
     except OSError:
         pass

@@ -162,10 +162,30 @@ def double_buffer(iterable, depth: int = 2):
     happens *after* the prefetcher. Thin front for the shared
     ``graphs.batching.background_iter`` machinery (exception propagation,
     prompt worker shutdown when the consumer abandons the iterator).
+
+    Each block's staging work (collate-stack + device_put, running in the
+    worker thread) is bracketed in a ``stage_block`` tracer span, so the
+    telemetry trace timeline shows staging overlapping superstep execution
+    — or failing to, which is the bottleneck this buffer exists to hide.
     """
     from ..graphs.batching import background_iter
+    from ..utils import tracer as tr
 
-    return background_iter(iterable, depth=depth)
+    _END = object()
+
+    def _staged():
+        it = iter(iterable)
+        while True:
+            tr.start("stage_block")
+            try:
+                block = next(it, _END)
+            finally:
+                tr.stop("stage_block")
+            if block is _END:
+                return
+            yield block
+
+    return background_iter(_staged(), depth=depth)
 
 
 __all__ = ["make_superstep", "double_buffer", "select_state"]

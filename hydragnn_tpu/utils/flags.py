@@ -290,6 +290,22 @@ THREADSAN = _register(Flag(
     "— diagnostics, not production serving."))
 
 # -- config / observability -------------------------------------------------
+TELEMETRY = _register(Flag(
+    "HYDRAGNN_TELEMETRY", "bool", True,
+    "The unified telemetry plane (hydragnn_tpu.telemetry): typed metrics "
+    "registry, structured event journal (logs/<run>/events.jsonl), and "
+    "correlated trace export. =0 turns the WHOLE plane into near-zero-cost "
+    "no-ops (accessors hand out a shared no-op instrument; journal emits "
+    "return immediately) — the telemetry_overhead_ab bench row holds the "
+    "enabled path under a <2% budget. Overrides Telemetry.enabled."))
+TRACE_EVENTS = _register(Flag(
+    "HYDRAGNN_TRACE_EVENTS", "bool", False,
+    "Record every tracer span as a Chrome trace event and let runs write a "
+    "perfetto-loadable logs/<run>/trace.json tagged with the journal's "
+    "correlation ids (run_id/epoch/step/recovery_id). Off by default — the "
+    "aggregate span timers (utils/tracer.py) always run; this arms the "
+    "per-span TIMELINE view. Overrides Telemetry.trace_events; requires "
+    "HYDRAGNN_TELEMETRY on."))
 USE_VARIABLE_GRAPH_SIZE = _register(Flag(
     "HYDRAGNN_USE_VARIABLE_GRAPH_SIZE", "bool", None,
     "Force the variable-graph-size config path (reference "

@@ -10,6 +10,14 @@ optional ``jax.profiler`` trace directory for XLA/perfetto dumps. Device-side
 timing is meaningless per-span under async dispatch — callers that need exact
 device timing should block on results; the ``train`` span brackets whole
 epochs, which *is* accurate because the loop syncs on metrics each batch.
+
+Spans are NESTED: each thread keeps an open-span stack, so ``dataload``
+inside ``train`` closes innermost-first and — when
+``HYDRAGNN_TRACE_EVENTS``/``Telemetry.trace_events`` arms the telemetry
+plane — every close emits one Chrome trace-event complete record
+(``hydragnn_tpu.telemetry.trace``) tagged with the journal's correlation
+ids, making ``logs/<run>/trace.json`` a perfetto-loadable timeline next to
+the aggregate timers this module always keeps.
 """
 
 from __future__ import annotations
@@ -17,8 +25,11 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 from collections import defaultdict
+
+from ..telemetry import trace as _trace
 
 
 class Timer:
@@ -44,6 +55,9 @@ class Timer:
 
 _timers: dict[str, Timer] = defaultdict(Timer)
 _jax_trace_dir: str | None = None
+# per-thread open-span stack [(name, t0_perf, t0_wall), ...] — threads never
+# share spans, so nesting needs no lock
+_spans = threading.local()
 
 
 def initialize(trace_dir: str | None = None, enable_jax_profiler: bool = False):
@@ -57,12 +71,30 @@ def initialize(trace_dir: str | None = None, enable_jax_profiler: bool = False):
         jax.profiler.start_trace(trace_dir)
 
 
+def _span_stack() -> list:
+    stack = getattr(_spans, "stack", None)
+    if stack is None:
+        stack = _spans.stack = []
+    return stack
+
+
 def start(name: str, **_ignored):
     _timers[name].start()
+    _span_stack().append((name, time.perf_counter(), time.time()))
 
 
 def stop(name: str, **_ignored):
     _timers[name].stop()
+    stack = _span_stack()
+    # pop the INNERMOST open span of this name (spans close LIFO in the
+    # loop's usage; the search keeps a stray out-of-order stop from
+    # corrupting unrelated open spans)
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] == name:
+            _, t0_perf, t0_wall = stack.pop(i)
+            if _trace.trace_enabled():
+                _trace.add_span(name, t0_wall, time.perf_counter() - t0_perf)
+            break
 
 
 @contextlib.contextmanager

@@ -1,0 +1,85 @@
+"""Sanctioned telemetry-plane patterns (hydragnn_tpu/telemetry/).
+
+The metrics registry and event journal are HOST code shared by the
+training thread, serve dispatchers, and watchdog/monitor threads. Their
+shape must stay silent under every GL rule:
+
+- the registry's instrument table and each instrument's value live behind
+  their own locks, every guarded attribute carrying its ``# guarded-by:``
+  declaration (GL101), and the only nesting is table-lock -> per-series
+  lock in ONE direction (GL102 stays acyclic);
+- snapshots hand back FRESH dicts — never an alias of a guarded mutable
+  (GL107);
+- the journal's wall stamp is a RECORD FIELD (``time.time()`` for humans
+  and cross-process correlation), never deadline arithmetic — durations
+  and orderings come from ``seq``/monotonic clocks, so GL105 stays quiet;
+- one line-buffered write per record under the writer lock (a file write
+  is not a GL104 blocking call; sleeps/sockets/futures stay outside);
+- the plane spawns NO threads of its own (GL106 has nothing to own) and
+  nothing here is jit-reachable (GL001/GL002/GL003 have no surface).
+"""
+import json
+import threading
+import time
+
+
+class CleanCounter:
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0  # guarded-by: _lock
+
+    def inc(self, by=1):
+        with self._lock:
+            self._value += by
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class CleanRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments = {}  # guarded-by: _lock
+
+    def counter(self, name):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = CleanCounter(name)
+            return inst  # the instrument owns its own lock; not a raw alias
+
+    def snapshot(self):
+        with self._lock:
+            items = list(self._instruments.items())
+        # values read OUTSIDE the table lock (per-series locks only): the
+        # result is a FRESH dict, never the guarded table itself
+        return {name: inst.value for name, inst in items}
+
+
+class CleanJournal:
+    def __init__(self, path):
+        self._lock = threading.Lock()
+        self._seq = 0  # guarded-by: _lock
+        self._f = open(path, "a", buffering=1)  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+
+    def emit(self, kind, **fields):
+        # wall stamp as a record FIELD (humans / cross-process correlation)
+        # — ordering guarantees come from seq, never wall-clock arithmetic
+        rec = {"kind": kind, "t_wall": time.time(), **fields}
+        with self._lock:
+            if self._closed:
+                return None
+            rec["seq"] = self._seq
+            self._seq += 1
+            self._f.write(json.dumps(rec) + "\n")
+            return rec["seq"]
+
+    def close(self):
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._f.close()

@@ -216,6 +216,17 @@ def test_elastic_controller_shape_is_clean():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_telemetry_plane_shape_is_clean():
+    """The telemetry plane's shape (hydragnn_tpu/telemetry: lock-per-series
+    registry with guarded-by declarations and one-directional table->series
+    nesting, fresh-dict snapshots, a line-buffered journal whose wall stamp
+    is a record field rather than deadline arithmetic, no threads of its
+    own) is sanctioned host code: every rule — GL101/GL102/GL105/GL107
+    above all — must stay silent on it."""
+    findings = analyze([str(FIXTURES / "telemetry_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_gl003_scan_folded_steps_are_clean():
     """lax.scan-folded supersteps (train/superstep.py's pattern: one jitted
     scan built outside the loop, dispatched per block) are the sanctioned

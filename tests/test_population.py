@@ -377,6 +377,39 @@ def test_fit_population_reports_diverged_member():
     assert summary["ensemble"]["variance"] is not None
 
 
+def test_population_summary_honors_path_argument(tmp_path, monkeypatch):
+    """Regression (ISSUE 15 satellite): ``population.json`` used to hardcode
+    ``"./logs"`` and ignore the configurable ``path=`` checkpoint.py threads
+    everywhere — a relocated log tree silently dropped its summary into the
+    CWD. ``train_population(path=...)`` must write the summary (and the
+    rolling per-epoch population checkpoints) under that path."""
+    from hydragnn_tpu.train.population import train_population
+
+    monkeypatch.chdir(tmp_path)  # a ./logs write would be visible here
+    monkeypatch.setenv("HYDRAGNN_VALTEST", "0")
+    cfg, model, opt, _, samples = setup_model(n_samples=48)
+    nn = copy.deepcopy(cfg["NeuralNetwork"])
+    nn["Training"]["num_epoch"] = 1
+    nn["Training"]["population"] = {"size": 2}
+    nn["Training"]["resilience"] = {"checkpoint_every_epoch": True}
+    loaders = (
+        GraphLoader(samples[:32], 4, shuffle=False),
+        GraphLoader(samples[32:40], 4),
+        GraphLoader(samples[40:], 4),
+    )
+    dest = tmp_path / "relocated"
+    _, summary = train_population(
+        model, opt, *loaders, nn, "pop_path_run", path=str(dest)
+    )
+    summary_path = dest / "pop_path_run" / "population.json"
+    assert summary_path.exists()
+    assert json.load(open(summary_path))["n_members"] == 2
+    # the rolling per-epoch checkpoint landed under the same root
+    assert (dest / "pop_path_run" / "checkpoints").exists()
+    # and NOTHING leaked into the hardcoded default
+    assert not (tmp_path / "logs" / "pop_path_run").exists()
+
+
 # -- config / flags / run_training routing -----------------------------------
 
 

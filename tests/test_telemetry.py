@@ -1,0 +1,684 @@
+"""Unified telemetry plane (ISSUE 15): registry, journal, traces, fleet op.
+
+* registry — typed instruments, label addressing, type-clash rejection,
+  exact totals under concurrent increments (the module runs under
+  ``threadsan_module``, so the registry/journal/context locks are also
+  cycle-checked), stable snapshots;
+* journal — schema'd records (seq/wall time/run_id/context ids), torn-tail
+  tolerance (the SIGKILL durability contract), disabled-path no-op;
+* traces — nested tracer spans become Chrome trace-event JSON that
+  round-trips through ``json`` (the perfetto-loadable contract);
+* correlation — a FORCED chaos ``device_loss`` recovery through the real
+  ``train_elastic`` loop produces an events.jsonl whose recovery_id-
+  correlated records reconstruct drain -> checkpoint -> re-mesh -> resume,
+  and the CLI renders that timeline;
+* fleet — the ``metrics`` wire op aggregates >= 2 replicas' registry
+  snapshots through the router.
+
+Slow budget (declared up front, ROADMAP 870 s constraint — the cap has
+ZERO slack on a bad box window): the two jit-heavy proofs are SLOW-marked
+— the full train_elastic recovery e2e (~15 s) and the warm-server fleet
+tests (~10 s fixture + traffic). Their non-slow stand-ins keep tier-1
+coverage of the same contracts at unit cost: the controller-driven
+correlation timeline (the identical signal/drain/checkpoint/re-mesh/
+resume record sequence, no jax training) and the fake-replica fleet
+``metrics`` op (real sockets + real wire codec, no AOT warm-up).
+Everything else is milliseconds.
+"""
+
+import copy
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import hydragnn_tpu.telemetry as tel
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.datasets import deterministic_graph_data
+from hydragnn_tpu.graphs.batching import GraphLoader
+from hydragnn_tpu.preprocess import apply_variables_of_interest
+from hydragnn_tpu.telemetry import TelemetryConfig, telemetry_config_defaults
+from hydragnn_tpu.telemetry.cli import main as cli_main, render_report
+from hydragnn_tpu.utils import flags
+from hydragnn_tpu.utils import tracer as tr
+
+from test_config import CI_CONFIG
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _threadsan(threadsan_module):
+    """Registry/journal/context/trace locks run under the lock-order
+    sanitizer for the whole module; the concurrency tests double as
+    deadlock drills."""
+    yield threadsan_module
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Every test starts (and leaves) the plane pristine: no override, no
+    open journal, empty context/registry/trace buffer."""
+    def _reset():
+        tel.configure(None)
+        tel.close_journal()
+        tel.clear_context()
+        tel.reset_metrics()
+        tel.reset_trace()
+
+    _reset()
+    yield
+    _reset()
+
+
+# -- registry -----------------------------------------------------------------
+
+
+def test_registry_typed_instruments_and_stable_snapshot():
+    tel.counter("reqs", model="gin", event="served").inc(3)
+    tel.gauge("depth", model="gin").set(7)
+    h = tel.histogram("lat_s")
+    h.observe(0.003)
+    h.observe(0.2)
+    snap = tel.snapshot()
+    assert snap["counters"]["reqs"]["event=served,model=gin"] == 3
+    assert snap["gauges"]["depth"]["model=gin"] == 7.0
+    hist = snap["histograms"]["lat_s"][""]
+    assert hist["count"] == 2 and hist["min"] == 0.003 and hist["max"] == 0.2
+    assert hist["buckets"]["0.005"] == 1 and hist["buckets"]["0.5"] == 2
+    # stable: a second snapshot is an equal, INDEPENDENT dict
+    snap2 = tel.snapshot()
+    assert snap2 == snap and snap2 is not snap
+    snap2["counters"]["reqs"]["event=served,model=gin"] = 99
+    assert tel.snapshot()["counters"]["reqs"]["event=served,model=gin"] == 3
+
+
+def test_registry_type_clash_and_negative_counter_rejected():
+    tel.counter("series_x").inc()
+    with pytest.raises(ValueError, match="one series, one type"):
+        tel.gauge("series_x")
+    with pytest.raises(ValueError, match="cannot decrease"):
+        tel.counter("series_x").inc(-1)
+
+
+def test_registry_concurrent_increments_exact():
+    """8 threads x 500 increments across shared and per-thread series:
+    totals exact (no lost updates), snapshot mid-churn never tears."""
+    n_threads, per_thread = 8, 500
+    errors = []
+
+    def worker(i: int):
+        try:
+            for _ in range(per_thread):
+                tel.counter("shared_total").inc()
+                tel.counter("per_thread", tid=str(i)).inc()
+                tel.snapshot()  # concurrent reads must never tear/raise
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    snap = tel.snapshot()
+    assert snap["counters"]["shared_total"][""] == n_threads * per_thread
+    for i in range(n_threads):
+        assert snap["counters"]["per_thread"][f"tid={i}"] == per_thread
+
+
+def test_publish_mirrors_numeric_leaves_only():
+    stats = {
+        "hits": 4, "rate": 0.5, "flag": True, "name": "x",
+        "nested": {"a": 1}, "items": [1, 2], "absent": None,
+    }
+    before = dict(stats)
+    tel.publish("cache", stats, shard="0")
+    assert stats == before  # the surface dict is untouched
+    gauges = tel.snapshot()["gauges"]
+    assert gauges["cache_hits"]["shard=0"] == 4.0
+    assert gauges["cache_rate"]["shard=0"] == 0.5
+    for skipped in ("cache_flag", "cache_name", "cache_nested",
+                    "cache_items", "cache_absent"):
+        assert skipped not in gauges
+
+
+def test_disabled_path_is_noop(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_TELEMETRY", "0")
+    assert tel.counter("anything") is tel.NOOP
+    tel.counter("anything").inc()  # must not raise, must not record
+    tel.gauge("g").set(5)
+    snap = tel.snapshot()
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {}}
+    tel.open_journal("run0", path=str(tmp_path))
+    assert tel.emit("epoch", epoch=0) is None
+    tel.close_journal()
+    assert tel.read_journal(str(tmp_path / "run0" / "events.jsonl")) == []
+    # trace events stay dark even when explicitly armed
+    monkeypatch.setenv("HYDRAGNN_TRACE_EVENTS", "1")
+    assert not tel.trace_enabled()
+
+
+# -- config block / flags -----------------------------------------------------
+
+
+def test_flags_registered():
+    assert flags.TELEMETRY.name == "HYDRAGNN_TELEMETRY"
+    assert flags.TELEMETRY.default is True
+    assert flags.TRACE_EVENTS.name == "HYDRAGNN_TRACE_EVENTS"
+    assert flags.TRACE_EVENTS.default is False
+    assert "HYDRAGNN_TELEMETRY" in flags.describe()
+
+
+def test_telemetry_config_block_defaults_and_unknown_keys():
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=8, seed=3)
+    samples = apply_variables_of_interest(samples, cfg)
+    aug = update_config(cfg, samples)
+    assert aug["Telemetry"] == telemetry_config_defaults()
+    bad = copy.deepcopy(aug)
+    bad["Telemetry"]["journla"] = True
+    with pytest.raises(ValueError, match="Unknown Telemetry key"):
+        update_config(bad, samples)
+    with pytest.raises(ValueError, match="Unknown Telemetry key"):
+        TelemetryConfig.from_config({"Telemetry": {"bogus": 1}})
+    with pytest.raises(ValueError, match="must be a bool"):
+        TelemetryConfig(enabled="yes").validate()
+
+
+def test_env_beats_config_and_configure_applies(monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_TELEMETRY", "0")
+    cfg = TelemetryConfig.from_config({"Telemetry": {"enabled": True}})
+    assert cfg.enabled is False  # env precedence
+    monkeypatch.delenv("HYDRAGNN_TELEMETRY")
+    monkeypatch.setenv("HYDRAGNN_TRACE_EVENTS", "1")
+    cfg = TelemetryConfig.from_config({"Telemetry": {"trace_events": False}})
+    assert cfg.trace_events is True
+    monkeypatch.delenv("HYDRAGNN_TRACE_EVENTS")
+    # configure() routes the (env-folded) block to the process overrides
+    tel.configure({"Telemetry": {"enabled": False}})
+    assert not tel.enabled() and tel.counter("x") is tel.NOOP
+    tel.configure(None)
+    assert tel.enabled()
+    tel.configure(TelemetryConfig(trace_events=True))
+    assert tel.trace_enabled()
+
+
+# -- journal ------------------------------------------------------------------
+
+
+def test_journal_schema_seq_and_correlation_context(tmp_path):
+    tel.open_journal("runA", path=str(tmp_path))
+    tel.set_context(epoch=2, recovery_id="rec1")
+    tel.emit("epoch", train_loss=0.25)
+    tel.set_context(recovery_id=None)  # retire one id, keep the other
+    tel.emit("shed", model="gin", reason="queue_full", epoch=3)
+    tel.close_journal()
+    recs = tel.read_journal(str(tmp_path / "runA" / "events.jsonl"))
+    assert [r["seq"] for r in recs] == [0, 1]
+    assert all(r["run_id"].startswith("runA-") for r in recs)
+    assert all(isinstance(r["t_wall"], float) for r in recs)
+    assert recs[0]["kind"] == "epoch"
+    assert recs[0]["epoch"] == 2 and recs[0]["recovery_id"] == "rec1"
+    assert "recovery_id" not in recs[1]
+    assert recs[1]["epoch"] == 3  # explicit field beats ambient context
+
+
+def test_journal_torn_tail_tolerated(tmp_path):
+    journal = tel.open_journal("runB", path=str(tmp_path))
+    for i in range(5):
+        tel.emit("epoch", epoch=i)
+    tel.close_journal()
+    with open(journal.path, "a") as f:
+        f.write('{"kind": "epoch", "epoch": 5, "t_wa')  # SIGKILL mid-write
+    recs = tel.read_journal(journal.path)
+    assert [r["epoch"] for r in recs] == [0, 1, 2, 3, 4]
+
+
+def test_journal_emit_from_threads_orders_seq(tmp_path):
+    journal = tel.open_journal("runC", path=str(tmp_path))
+    threads = [
+        threading.Thread(
+            target=lambda i=i: [tel.emit("tick", src=i) for _ in range(50)],
+            daemon=True,
+        )
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    tel.close_journal()
+    recs = tel.read_journal(journal.path)
+    assert len(recs) == 200
+    # seq order == file order, gap-free, even under concurrent writers
+    assert [r["seq"] for r in recs] == list(range(200))
+
+
+# -- trace export -------------------------------------------------------------
+
+
+def test_nested_spans_emit_chrome_trace_events(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_TRACE_EVENTS", "1")
+    tel.set_context(epoch=4)
+    with tr.span("train"):
+        with tr.span("dataload"):
+            pass
+        with tr.span("dataload"):
+            pass
+    path = tel.save_trace(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))  # MUST parse as plain JSON
+    events = doc["traceEvents"]
+    assert [e["name"] for e in events] == ["dataload", "dataload", "train"]
+    for e in events:
+        assert e["ph"] == "X" and e["dur"] >= 0 and e["ts"] > 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["args"]["epoch"] == 4  # the journal's correlation ids
+    train = events[-1]
+    for inner in events[:2]:  # nesting: children inside the parent window
+        assert inner["ts"] >= train["ts"]
+        assert inner["ts"] + inner["dur"] <= train["ts"] + train["dur"] + 1.0
+    # aggregate timers kept working alongside (the pre-existing surface)
+    assert tr.get("dataload").count == 2
+
+
+def test_trace_disabled_records_nothing():
+    count0 = tr.get("train").count  # the aggregate timers are process-global
+    with tr.span("train"):
+        pass
+    assert tel.trace_events() == []
+    assert tr.get("train").count == count0 + 1  # timers still aggregate
+
+
+def test_trace_buffer_bounded():
+    buf = tel.trace_events  # module surface stays empty; use a local buffer
+    from hydragnn_tpu.telemetry.trace import TraceBuffer
+
+    small = TraceBuffer(max_events=3)
+    for i in range(5):
+        small.add_complete(f"s{i}", 0.0, 1e-3)
+    assert len(small.events()) == 3 and small.dropped() == 2
+    assert buf() == []
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def _write_events(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_cli_renders_timeline_sections(tmp_path, capsys):
+    events = str(tmp_path / "run" / "events.jsonl")
+    t0 = 1000.0
+    _write_events(events, [
+        {"kind": "run_start", "t_wall": t0, "seq": 0, "run_id": "run-1"},
+        {"kind": "epoch", "t_wall": t0 + 10, "seq": 1, "epoch": 0,
+         "train_loss": 0.5, "duration_s": 9.5, "raw_batches": 12},
+        {"kind": "fault", "t_wall": t0 + 11, "seq": 2, "epoch": 1,
+         "recovery_id": "rec1", "fault": "device_loss"},
+        {"kind": "recovery_phase", "t_wall": t0 + 11.1, "seq": 3,
+         "recovery_id": "rec1", "phase": "draining"},
+        {"kind": "recovery_phase", "t_wall": t0 + 11.5, "seq": 4,
+         "recovery_id": "rec1", "phase": "re-mesh"},
+        {"kind": "recovery", "t_wall": t0 + 11.9, "seq": 5,
+         "recovery_id": "rec1", "mode": "remesh", "recovery_ms": 400.0,
+         "faults": ["device_loss"]},
+        {"kind": "recovery_phase", "t_wall": t0 + 12, "seq": 6,
+         "recovery_id": "rec1", "phase": "resumed"},
+        {"kind": "shed", "t_wall": t0 + 13, "seq": 7, "model": "gin",
+         "reason": "queue_full"},
+        {"kind": "epoch", "t_wall": t0 + 20, "seq": 8, "epoch": 1,
+         "train_loss": 0.4, "duration_s": 8.0, "raw_batches": 12},
+    ])
+    assert cli_main([events]) == 0
+    out = capsys.readouterr().out
+    assert "recoveries (1):" in out and "rec1:" in out
+    assert "mode=remesh" in out and "recovery_ms=400.0" in out
+    for phase in ("draining", "re-mesh", "resumed"):
+        assert phase in out
+    assert "epoch throughput:" in out and "batches/s" in out
+    assert "shed gin [queue_full]: 1" in out
+    # run dir form resolves events.jsonl + sibling trace.json
+    assert cli_main([str(tmp_path / "run")]) == 0
+
+
+# -- correlation through a forced chaos recovery (the acceptance e2e) ---------
+
+
+def test_controller_recovery_records_correlate_without_training(tmp_path):
+    """Non-slow stand-in for the train_elastic e2e below: the SAME
+    controller emits the SAME record sequence when driven directly — a
+    fault signal stamps the recovery_id at signal time (so the mid-drain
+    checkpoint record correlates), phases follow in order, and re-entering
+    "running" retires the id."""
+    from hydragnn_tpu.resilience.elastic import ElasticController, Fault
+
+    journal = tel.open_journal("ctl", path=str(tmp_path))
+    ctl = ElasticController(devices=list("abcd"))
+    ctl.set_state("running")
+    ctl.signal(Fault(kind="device_loss", device=2, detail="chaos"))
+    # the drain's mid-epoch checkpoint happens while draining — its record
+    # must already carry the id (this is what the loop's save emits)
+    tel.emit("preempt_checkpoint", epoch=1, raw_done=8, mid_epoch=True)
+    faults = ctl.take_pending()
+    ctl.set_state("re-mesh")
+    ctl.apply(faults[0])
+    ctl.note_recovery(faults, "remesh", 120.0, {"epoch": 1, "n_dev": 4})
+    ctl.set_state("resumed", "remesh in 120 ms")
+    ctl.set_state("running")
+    tel.emit("epoch", epoch=1, train_loss=0.1)
+    tel.close_journal()
+
+    recs = tel.read_journal(journal.path)
+    rec1 = [r for r in recs if r.get("recovery_id") == "rec1"]
+    kinds = [(r["kind"], r.get("phase")) for r in rec1]
+    assert kinds == [
+        ("fault", None),
+        ("recovery_phase", "draining"),
+        ("preempt_checkpoint", None),
+        ("recovery_phase", "re-mesh"),
+        ("recovery", None),
+        ("recovery_phase", "resumed"),
+    ]
+    summary = rec1[4]
+    assert summary["mode"] == "remesh" and summary["lost_indices"] == [2]
+    # the post-recovery records retired the id
+    tail = [r for r in recs if r["seq"] > rec1[-1]["seq"]]
+    assert tail and all("recovery_id" not in r for r in tail)
+    report = render_report(recs)
+    assert "rec1:" in report and "mode=remesh" in report
+
+
+@pytest.mark.slow
+def test_forced_recovery_journal_correlates_and_cli_renders(
+    tmp_path, monkeypatch
+):
+    """ISSUE 15 acceptance: ONE forced chaos recovery from a CAMPAIGN SEED
+    (``random_fault_schedule`` pinned to the device_loss vocabulary — the
+    same seeded scheduler the chaos campaign runs) produces an
+    events.jsonl whose recovery_id-correlated records reconstruct the full
+    drain -> checkpoint -> re-mesh -> resume timeline, trace.json parses
+    as Chrome trace-event JSON, and the CLI renders the recovery."""
+    import jax
+
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.parallel import make_mesh, shard_state
+    from hydragnn_tpu.resilience import FaultPlan, Resilience, train_elastic
+    from hydragnn_tpu.resilience.campaign import random_fault_schedule
+    from hydragnn_tpu.resilience.elastic import ElasticController
+    from hydragnn_tpu.train import create_train_state, select_optimizer
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_VALTEST", "0")
+    monkeypatch.setenv("HYDRAGNN_TRACE_EVENTS", "1")
+
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=48, seed=9)
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    nn = copy.deepcopy(cfg["NeuralNetwork"])
+    nn["Training"]["num_epoch"] = 2
+    model = create_model_config(cfg)
+    opt = select_optimizer(nn["Training"]["Optimizer"])
+    mesh4 = make_mesh(devices=jax.devices()[:4])
+    loaders = (
+        GraphLoader(samples, 4, shuffle=False),  # 12 raw = 3 dispatches
+        GraphLoader(samples[:8], 4),
+        GraphLoader(samples[8:16], 4),
+    )
+    state = shard_state(
+        create_train_state(model, opt, next(iter(loaders[0]))), mesh4
+    )
+
+    # campaign seed 1 on the (2 epochs x 3 dispatches x 4 devices) grid
+    # with the recovery vocabulary: deterministically one device_loss in
+    # the final epoch (asserted, so a scheduler change can't silently turn
+    # this into a different drill)
+    schedule = random_fault_schedule(
+        1, epochs=2, dispatches=3, n_devices=4, kinds=("device_loss",),
+        max_faults=1,
+    )
+    assert [e["fault"] for e in schedule] == ["device_loss"]
+    assert schedule[0]["epoch"] == 1
+
+    journal = tel.open_journal("tele_recovery", path=str(tmp_path / "logs"))
+    res = Resilience.from_config(nn["Training"])
+    res.chaos = FaultPlan.parse(json.dumps(schedule))
+    ctl = ElasticController()
+    train_elastic(
+        model, opt, state, *loaders, nn, "tele_recovery", verbosity=0,
+        mesh=mesh4, resilience=res, controller=ctl,
+    )
+    trace_path = tel.save_trace(str(tmp_path / "logs" / "trace.json"))
+    tel.close_journal()
+    assert ctl.recoveries == 1 and ctl.state == "done"
+
+    recs = tel.read_journal(journal.path)
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+    rec1 = [r for r in recs if r.get("recovery_id") == "rec1"]
+    assert rec1, "no recovery_id-correlated records"
+    kinds = [(r["kind"], r.get("phase")) for r in rec1]
+    # the full timeline, in order, all under ONE correlation id:
+    # fault -> drain -> (mid-epoch checkpoint) -> re-mesh -> resume
+    i_fault = kinds.index(("fault", None))
+    i_drain = kinds.index(("recovery_phase", "draining"))
+    i_ckpt = next(
+        i for i, r in enumerate(rec1) if r["kind"] == "preempt_checkpoint"
+    )
+    i_mesh = kinds.index(("recovery_phase", "re-mesh"))
+    i_sum = next(i for i, r in enumerate(rec1) if r["kind"] == "recovery")
+    i_resume = kinds.index(("recovery_phase", "resumed"))
+    assert i_fault < i_drain < i_ckpt < i_mesh <= i_sum < i_resume
+    assert rec1[i_fault]["fault"] == "device_loss"
+    assert rec1[i_ckpt]["mid_epoch"] is True and rec1[i_ckpt]["epoch"] == 1
+    summary = rec1[i_sum]
+    assert summary["mode"] == "remesh" and summary["faults"] == ["device_loss"]
+    assert summary["recovery_ms"] < 60_000
+    # records AFTER the recovery retired its id no longer carry it
+    post = [r for r in recs if r["seq"] > rec1[-1]["seq"]]
+    assert post and all("recovery_id" not in r for r in post)
+    # every epoch record correlates by epoch id
+    epochs = [r for r in recs if r["kind"] == "epoch"]
+    assert [r["epoch"] for r in epochs] == [0, 1]
+
+    # trace.json: plain-JSON Chrome trace-event format, spans present and
+    # tagged with the same correlation ids
+    doc = json.load(open(trace_path))
+    events = doc["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    assert {"train", "dataload"} <= {e["name"] for e in events}
+    assert any(e.get("args", {}).get("recovery_id") == "rec1" for e in events)
+
+    # the CLI reconstructs the same story
+    report = render_report(recs, trace_path=trace_path)
+    assert "rec1:" in report and "mode=remesh" in report
+    for phase in ("draining", "re-mesh", "resumed"):
+        assert phase in report
+    assert "epoch throughput:" in report
+    assert "train" in report.split("top spans")[1]
+
+
+# -- fleet `metrics` wire op --------------------------------------------------
+
+
+class _FakeEndpoint:
+    def __init__(self):
+        import types
+
+        self.cfg = types.SimpleNamespace(quantize=False)
+        self.executables_quant = {}
+
+
+class _FakeServer:
+    """Just enough PredictionServer surface for the wire ops the metrics
+    test exercises (ping identity + stats), so the non-slow tier proves
+    the REAL sockets/codec/aggregation without an AOT warm-up."""
+
+    def __init__(self, served: int):
+        self._models = {"gin": _FakeEndpoint()}
+        self._served = served
+
+    def stats(self) -> dict:
+        return {
+            "gin": {
+                "queue_depth": 0, "shed": 1, "served": self._served,
+                "submitted": self._served + 1,
+            }
+        }
+
+
+def test_fleet_metrics_op_aggregates_two_fake_replicas():
+    """Non-slow half of the fleet acceptance: the ``metrics`` wire op and
+    ``FleetRouter.metrics()`` aggregation over TWO replicas, real sockets
+    + real wire codec, fake endpoints (no AOT warm-up)."""
+    from hydragnn_tpu.serve import FleetRouter, ReplicaHost
+
+    host_a = ReplicaHost(_FakeServer(served=3))
+    host_b = ReplicaHost(_FakeServer(served=5))
+    router = FleetRouter({"peer_timeout": 5.0, "cache_bytes": 1 << 16})
+    try:
+        router.attach("127.0.0.1", host_a.port)
+        router.attach("127.0.0.1", host_b.port)
+        m = router.metrics()  # aggregation needs no dispatcher thread
+        assert sorted(m["replicas"]) == ["0", "1"]
+        for rank in ("0", "1"):
+            rep = m["replicas"][rank]
+            assert set(rep["registry"]) == {
+                "counters", "gauges", "histograms"
+            }
+            assert rep["stats"]["steady_lowerings"] == 0
+        agg = m["aggregate"]
+        assert agg["replicas_total"] == 2 and agg["replicas_reporting"] == 2
+        assert agg["served"] == 8 and agg["shed"] == 2
+        assert agg["steady_lowerings"] == 0 and agg["queue_depth"] == 0
+        # the router's own registry rode along
+        assert "fleet_cache_hits" in m["registry"]["gauges"]
+    finally:
+        router._rt.close()
+        host_a.close()
+        host_b.close()
+
+
+def test_cache_stats_stay_pinned_and_publish():
+    """The answer cache's stats dict stays byte-compatible while mirroring
+    into the registry (part of the unification satellite)."""
+    from hydragnn_tpu.serve.fleet.cache import AnswerCache
+
+    cache = AnswerCache(1 << 16)
+    cache.put("k" * 64, [np.zeros(4, np.float32)])
+    cache.get("k" * 64)
+    cstats = cache.stats()
+    assert set(cstats) == {
+        "entries", "bytes", "budget_bytes", "hits", "misses", "hit_rate",
+        "insertions", "evictions", "oversize_skips",
+    }
+    gauges = tel.snapshot()["gauges"]
+    assert gauges["fleet_cache_hits"][""] == 1.0
+    assert gauges["fleet_cache_entries"][""] == 1.0
+
+
+@pytest.fixture(scope="module")
+def warm_server():
+    """ONE minimal warm GIN PredictionServer (single small bucket table)
+    shared by the fleet-metrics tests — the expensive part is the AOT
+    warm-up, paid once for the module."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.preprocess.load_data import dataset_loading_and_splitting
+    from hydragnn_tpu.serve import PredictionServer, ServingConfig
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.step import create_train_state
+
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = deterministic_graph_data(number_configurations=24, seed=7)
+    tl, vl, sl = dataset_loading_and_splitting(
+        copy.deepcopy(cfg), samples=samples
+    )
+    aug = update_config(copy.deepcopy(cfg), tl.samples, vl.samples, sl.samples)
+    model = create_model_config(aug)
+    opt = select_optimizer(aug["NeuralNetwork"]["Training"]["Optimizer"])
+    state = create_train_state(
+        model, opt, jax.tree.map(jnp.asarray, next(iter(tl)))
+    )
+    server = PredictionServer(ServingConfig(flush_ms=2.0))
+    server.add_model(
+        "gin", model, state, aug, samples=samples, batch_size=8,
+        max_buckets=2,
+    )
+    server.warmup(verify=False)
+    server.start()
+    yield {"server": server, "samples": samples}
+    server.stop()
+
+
+@pytest.mark.slow
+def test_fleet_metrics_op_aggregates_two_replicas(warm_server):
+    """ISSUE 15 acceptance (full-fat): the ``metrics`` wire op exposes each
+    WARM replica's registry snapshot over the existing transport and
+    ``FleetRouter`` aggregates a fleet-wide view (>= 2 replicas) under
+    real predict traffic, next to its own stats."""
+    from hydragnn_tpu.serve import FleetRouter, ReplicaHost
+
+    server, samples = warm_server["server"], warm_server["samples"]
+    host_a = ReplicaHost(server)
+    host_b = ReplicaHost(server)
+    router = FleetRouter({"peer_timeout": 5.0, "cache_bytes": 1 << 20})
+    try:
+        router.attach("127.0.0.1", host_a.port)
+        router.attach("127.0.0.1", host_b.port)
+        router.start()
+        # some real traffic so the aggregated series are non-trivial
+        for s in samples[:4]:
+            router.submit("gin", s).result(timeout=30)
+        m = router.metrics()
+        assert set(m) == {"router", "registry", "replicas", "aggregate"}
+        assert sorted(m["replicas"]) == ["0", "1"]
+        for rank in ("0", "1"):
+            rep = m["replicas"][rank]
+            assert "registry" in rep and "stats" in rep
+            assert set(rep["registry"]) == {
+                "counters", "gauges", "histograms"
+            }
+            # the replica's registry carries the serve-side dual-writes
+            assert "serve_requests" in rep["registry"]["counters"]
+        agg = m["aggregate"]
+        assert agg["replicas_total"] == 2 and agg["replicas_reporting"] == 2
+        # in-process replicas share one server: each op's stats() reports
+        # the same endpoint totals, so the sum is 2x the served count
+        assert agg["served"] >= 4
+        assert agg["steady_lowerings"] == 0  # AOT guarantee, over the wire
+        assert agg["queue_depth"] == 0
+        # the router's own registry mirrors the fleet counters + cache
+        counters = m["registry"]["counters"].get("fleet_requests", {})
+        assert counters.get("event=served", 0) >= 4
+        assert "fleet_cache_hits" in m["registry"]["gauges"]
+    finally:
+        router.stop()
+        host_a.close()
+        host_b.close()
+
+
+@pytest.mark.slow
+def test_stats_surfaces_stay_pinned_and_publish(warm_server):
+    """The serve stats surface keeps its dict shape byte-compatible while
+    mirroring into the registry (the unification satellite; the cache half
+    runs non-slow above)."""
+    server = warm_server["server"]
+    stats = server.stats()["gin"]
+    for key in ("submitted", "served", "shed", "queue_depth", "buckets",
+                "warm_executables", "occupancy"):
+        assert key in stats
+    gauges = tel.snapshot()["gauges"]
+    assert gauges["serve_queue_depth"]["model=gin"] == stats["queue_depth"]
