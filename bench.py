@@ -825,6 +825,147 @@ def bench_serving_ab(batch_size: int = 32, n_requests: int = 160,
     }
 
 
+def bench_screen_throughput_ab(batch_size: int = 32, n_graphs: int = 256,
+                               windows: int = 4, topk: int = 32) -> dict:
+    """Bulk-screening A/B (ISSUE 17): the streamed bucket-major screener
+    (planner blocks + double-buffered staging + batched ``fetch_many``) vs
+    the naive arm every screening script starts as — synchronous per-batch
+    fetch, stream-order blocks (``prefetch=0, bulk=False, bucket_major=
+    False``: a flag-only difference over the SAME engine and the SAME warm
+    executables). CPU-provable columns: per-arm steady-state lowering deltas
+    (ZERO for both — every planned block draws its shape from the warmed
+    bucket table), ranked-top-k bit-identity across the arms AND vs a plain
+    jit evaluation of the same blocks (the ``run_prediction`` core without
+    AOT override), graphs/sec per arm, ABBA paired-window wall clock at
+    budget 0 ('pass' = the streamed arm clears the noise floor)."""
+    import numpy as np
+
+    from hydragnn_tpu.analysis.sentinel import compile_counts
+    from hydragnn_tpu.graphs.batching import compute_pad_buckets
+    from hydragnn_tpu.screen import BulkScreener, ScreeningConfig
+    from hydragnn_tpu.serve import Predictor, serving_collate
+
+    cfg, model, state, samples = _fleet_model_ingredients(
+        batch_size, n_samples=n_graphs
+    )
+    predictor = Predictor(model, state, cfg)
+    buckets = compute_pad_buckets(samples, batch_size, max_buckets=4)
+
+    class _ListStore:
+        """In-memory store speaking the full store surface, so each arm
+        exercises its intended fetch path (``fetch_many`` vs ``fetch``)."""
+
+        def __init__(self, samples):
+            self.samples = samples
+
+        def __len__(self):
+            return len(self.samples)
+
+        def sample_sizes(self, indices):
+            return np.asarray(
+                [(self.samples[int(i)].num_nodes,
+                  self.samples[int(i)].num_edges) for i in indices],
+                np.int64,
+            )
+
+        def fetch(self, indices):
+            return [self.samples[int(i)] for i in indices]
+
+        fetch_many = fetch
+
+    store = _ListStore(samples)
+    streamed = BulkScreener(
+        predictor, buckets, samples[0],
+        cfg=ScreeningConfig(topk=topk, batch_size=batch_size, prefetch=2),
+    )
+    naive = BulkScreener(
+        predictor, buckets, samples[0],
+        cfg=ScreeningConfig(topk=topk, batch_size=batch_size, prefetch=0,
+                            bucket_major=False),
+    )
+    c0 = compile_counts()["lowerings"]
+    t0 = time.perf_counter()
+    streamed.warm(verify=True)
+    naive.executables = streamed.executables  # same models, same table
+    compiles_warmup = compile_counts()["lowerings"] - c0
+    warmup_s = time.perf_counter() - t0
+
+    # untimed burn-in pair, then alternate arm order window to window
+    naive.screen(store, bulk=False)
+    ref_streamed = streamed.screen(store)
+    a_ms, b_ms = [], []
+    gps = {"naive": [], "streamed": []}
+    compiles = {"naive": 0, "streamed": 0}
+
+    def run_arm(name, scr, bulk):
+        s0 = compile_counts()["lowerings"]
+        res = scr.screen(store, bulk=bulk)
+        compiles[name] += compile_counts()["lowerings"] - s0
+        gps[name].append(res.graphs_per_sec)
+        return res
+
+    for w in range(max(windows, 1)):
+        if w % 2 == 0:
+            ra = run_arm("naive", naive, False)
+            rb = run_arm("streamed", streamed, True)
+        else:
+            rb = run_arm("streamed", streamed, True)
+            ra = run_arm("naive", naive, False)
+        a_ms.append(1e3 * ra.elapsed_s)
+        b_ms.append(1e3 * rb.elapsed_s)
+    key = lambda res: [(e.index, e.score) for e in res.topk]
+    arms_bitmatch = key(ra) == key(rb) == key(ref_streamed)
+
+    # reference: the same planned blocks through the plain jit predict path
+    # (exactly what run_prediction executes — no AOT override)
+    from hydragnn_tpu.screen import plan_screen
+
+    plan = plan_screen(store, range(len(store)), buckets)
+    ref_entries = []
+    for blk in plan.blocks:
+        batch = serving_collate(store.fetch(blk.indices), blk.pad)
+        head = np.asarray(predictor.outputs(batch)[0])
+        mask = np.asarray(batch.graph_mask) > 0
+        scores = head[mask][:, 0].astype(np.float32)
+        ref_entries.extend(
+            (float(s), int(i)) for i, s in zip(blk.indices, scores)
+        )
+    ref_top = sorted(ref_entries, key=lambda t: (-t[0], t[1]))[:topk]
+    ref_bitmatch = [(i, s) for s, i in ref_top] == key(rb)
+
+    overhead_pct, noise_pct, verdict = _abba_verdict(a_ms, b_ms, budget_pct=0.0)
+    return {
+        "workload": "screen_throughput_ab",
+        "n_graphs_per_window": len(samples),
+        "n_blocks": len(plan.blocks),
+        "n_tail_blocks": plan.n_tail_blocks,
+        "n_buckets": len(buckets),
+        "topk": topk,
+        "warmup_s": round(warmup_s, 3),
+        "compiles_warmup": compiles_warmup,
+        # steady-state lowering deltas per arm: the zero-recompile guarantee
+        "compiles_steady_naive": compiles["naive"],
+        "compiles_steady_streamed": compiles["streamed"],
+        "graphs_per_sec_naive": round(statistics.median(gps["naive"]), 1),
+        "graphs_per_sec_streamed": round(
+            statistics.median(gps["streamed"]), 1
+        ),
+        "window_ms_naive": [round(x, 2) for x in a_ms],
+        "window_ms_streamed": [round(x, 2) for x in b_ms],
+        "ranked_scores_bitmatch_arms": bool(arms_bitmatch),
+        "ranked_scores_bitmatch_reference": bool(ref_bitmatch),
+        "screen_speedup": round(
+            statistics.median(a_ms) / statistics.median(b_ms), 4
+        ),
+        # _abba_verdict measures streamed-vs-naive overhead; negative =
+        # the streamed arm wins
+        "streamed_overhead_pct": round(overhead_pct, 2),
+        "noise_pct": round(noise_pct, 2),
+        "verdict": verdict,
+        "batch_size": batch_size,
+    }
+
+
 def _fleet_model_ingredients(batch_size: int, n_samples: int = 256,
                              seed: int = 41):
     """Tiny GIN serving ingredients shared by the fleet rows (same family
@@ -2207,6 +2348,9 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
     # ISSUE 15 row: telemetry-plane overhead is pure host bookkeeping,
     # CPU-provable by construction — the smoke carries the full A/B
     telemetry_overhead = _row(bench_telemetry_overhead_ab, min(batch_size, 64), 2, 6)
+    # ISSUE 17 row: bulk-screening throughput A/B is CPU-provable by
+    # construction (flag-identity arms + bit-identity + lowering counts)
+    screen_throughput = _row(bench_screen_throughput_ab, min(batch_size, 32), 128)
     return {
         "workload": "cpu_smoke",
         "degraded": True,
@@ -2227,6 +2371,7 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
         "autotune_ab": autotune_ab,
         "elastic_remesh_ab": elastic_remesh,
         "telemetry_overhead_ab": telemetry_overhead,
+        "screen_throughput_ab": screen_throughput,
     }
 
 
@@ -3044,6 +3189,12 @@ def child_main(status_path: str) -> None:
     # record counts as did-the-work evidence) — CPU-provable by construction
     plan.append(("telemetry_overhead_ab",
                  lambda: bench_telemetry_overhead_ab(batch_size)))
+    # ISSUE 17 acceptance row: streamed bucket-major bulk screening vs the
+    # naive synchronous per-batch-fetch arm (0 steady lowerings per arm,
+    # ranked-score bit-identity across arms and vs the plain jit evaluator,
+    # graphs/sec headline) — CPU-provable by construction
+    plan.append(("screen_throughput_ab",
+                 lambda: bench_screen_throughput_ab(min(batch_size, 32))))
     if os.getenv("BENCH_FUSED_AUTOTUNE", "1") != "0":
         # cheap kernel-only sweep BEFORE the compile-heavy arch entries, so
         # a short window still yields the tuning data it was added for

@@ -31,7 +31,7 @@ import numpy as np
 # consumer instead of drifting across hand-copied sets).
 CONFIG_SECTIONS = frozenset(
     {"Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving",
-     "MD", "Telemetry"}
+     "MD", "Telemetry", "Screening"}
 )
 
 # Architectures grouped by capability (reference ``config_utils.py:64,179-206``).
@@ -229,6 +229,28 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     for key, val in tel_defaults.items():
         tel_cfg.setdefault(key, val)
     TelemetryConfig(**tel_cfg).validate()  # one range-check implementation
+
+    # bulk screening (hydragnn_tpu.screen): the top-level Screening block's
+    # defaults ARE the ScreeningConfig dataclass field defaults (same
+    # single-source pattern); HYDRAGNN_SCREEN_TOPK / HYDRAGNN_SCREEN_PREFETCH
+    # env flags win at engine construction (ScreeningConfig.apply_env).
+    screen_cfg = config.setdefault("Screening", {})
+    if not isinstance(screen_cfg, dict):
+        raise ValueError(
+            f"Screening must be a dict, got {type(screen_cfg).__name__}"
+        )
+    from ..screen import ScreeningConfig, screening_config_defaults
+
+    screen_defaults = screening_config_defaults()
+    unknown_screen = set(screen_cfg) - set(screen_defaults)
+    if unknown_screen:
+        raise ValueError(
+            f"Unknown Screening key(s) {sorted(unknown_screen)}; known: "
+            f"{sorted(screen_defaults)}"
+        )
+    for key, val in screen_defaults.items():
+        screen_cfg.setdefault(key, val)
+    ScreeningConfig(**screen_cfg).validate()  # one range-check impl
 
     # --- GPS / encoding defaults (reference :40-48) ---
     arch.setdefault("global_attn_engine", None)

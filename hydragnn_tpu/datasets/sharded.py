@@ -879,6 +879,77 @@ class ShardedStore:
             result.append(s)
         return result
 
+    def fetch_many(self, indices) -> list[GraphSample]:
+        """Bulk streaming read: the screening planner's wire op
+        (``hydragnn_tpu.screen``). Same replica-set grouping and failover as
+        :meth:`fetch` — ONE framed request per span per replica set, local
+        spans straight from mmap — but it BYPASSES the LRU cache entirely:
+
+        * no cache-bookkeeping lock traffic and no pristine-copy memcpy per
+          sample on the hot path (a screen touches each sample exactly once,
+          so a hit can never pay back the copy), and
+        * no pollution — a multi-million-graph sweep would otherwise evict
+          the training/serving working set the cache exists for.
+
+        The per-sample :meth:`fetch` surface (cache, copy-on-hit isolation,
+        duplicate-instance contract) is untouched; ``fetch`` remains the
+        right call for loaders that revisit samples. Remote samples are
+        freshly decoded (writable) instances; LOCAL spans remain zero-copy
+        READ-ONLY mmap views, as in ``fetch``. Duplicate remote indices get
+        independent copies (same isolation contract as ``fetch``)."""
+        out: dict[int, GraphSample] = {}
+        by_owner: dict[tuple[int, ...], list[int]] = {}
+        for i in map(int, indices):
+            if self.start <= i < self.stop:
+                out[i] = self.ds[i - self.start]  # zero-copy mmap read
+            elif i not in out:
+                out[i] = None  # type: ignore[assignment]  # placeholder: dedup
+                by_owner.setdefault(self._owners(i), []).append(i)
+
+        def fetch_owner(item):
+            ranks, idxs = item
+            z, _, _, _ = self._failover_request(
+                ranks,
+                lambda a0, a1: dict(
+                    idx=np.asarray([i - a0 for i in idxs], np.int64),
+                    range=np.asarray([a0, a1], np.int64),
+                ),
+                what=f"bulk fetch of {len(idxs)} sample(s) from range "
+                     f"[{min(idxs)}, {max(idxs)}]",
+            )
+            return idxs, _samples_from_frame(z)
+
+        if len(by_owner) <= 1:
+            results = [fetch_owner(it) for it in by_owner.items()]
+        else:
+            # same persistent fan-out pool as fetch: many owners, one RTT
+            if self._executor is None:
+                with self._lock:
+                    if self._executor is None:
+                        self._executor = ThreadPoolExecutor(16)
+            results = list(self._executor.map(fetch_owner, by_owner.items()))
+        n_remote = 0
+        for idxs, samples in results:
+            n_remote += len(samples)
+            for i, s in zip(idxs, samples):
+                out[i] = s
+        if n_remote:
+            from .. import telemetry as tel
+
+            tel.counter("store_remote_fetches_total").inc(n_remote)
+            with self._lock:
+                self.remote_fetches += n_remote
+        result: list[GraphSample] = []
+        emitted: set[int] = set()
+        for i in map(int, indices):
+            s = out[i]
+            if i in emitted and not (self.start <= i < self.stop):
+                s = _copy_sample(s)
+            else:
+                emitted.add(i)
+            result.append(s)
+        return result
+
     def pad_spec(self, batch_size: int, node_multiple: int = 8, edge_multiple: int = 128):
         """PadSpec from shard-local writer stats, maxed across hosts when
         under jax.distributed (stats are per-shard)."""

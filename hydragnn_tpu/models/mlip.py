@@ -127,13 +127,20 @@ def energy_force_loss(spec: ModelSpec, graph_e, forces, batch: GraphBatch):
     return tot, [e_loss, ea_loss, f_loss]
 
 
-def make_mlip_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32):
-    """Jitted MLIP train step: outer grad over (inner force grad + losses)."""
+def make_mlip_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32,
+                         loss_scale=None):
+    """Jitted MLIP train step: outer grad over (inner force grad + losses).
+
+    ``loss_scale`` as in ``train.step._make_step_impl`` (static fp16-class
+    scaling; None/1 keeps the historical program byte-for-byte). Only the
+    OUTER (param) objective is scaled — the inner position grad must stay in
+    physical units because the forces it produces feed the loss itself."""
     from ..train.step import TrainState, _cast_floats
 
     spec = model.spec
     validate_mlip_spec(spec)
     energy_fn = make_graph_energy_fn(model)
+    loss_scale = None if not loss_scale or float(loss_scale) == 1.0 else float(loss_scale)
 
     def loss_fn(params, batch_stats, batch: GraphBatch, dropout_rng):
         c_params = _cast_floats(params, compute_dtype)
@@ -185,6 +192,10 @@ def make_mlip_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32
             tot, tasks, new_stats = compute(
                 _cast_floats(batch, compute_dtype), batch, dropout_rng
             )
+        if loss_scale is not None:
+            # differentiate the scaled loss; the unscaled one rides out via
+            # aux so metrics never see the scale
+            return tot * loss_scale, (tot, tasks, new_stats)
         return tot, (tasks, new_stats)
 
     from ..train.step import donate_state_argnums
@@ -192,12 +203,19 @@ def make_mlip_train_step(model: HydraModel, optimizer, compute_dtype=jnp.float32
     @functools.partial(jax.jit, donate_argnums=donate_state_argnums())
     def train_step(state: TrainState, batch: GraphBatch):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
-        (tot, (tasks, new_stats)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (tot, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, batch, dropout_rng
         )
         from ..train.step import freeze_conv_grads
 
-        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), spec)
+        grads = _cast_floats(grads, jnp.float32)
+        if loss_scale is not None:
+            # un-scale AFTER the fp32 cast (2^k scales divide back exactly)
+            tot, tasks, new_stats = aux
+            grads = jax.tree.map(lambda g: g / loss_scale, grads)
+        else:
+            tasks, new_stats = aux
+        grads = freeze_conv_grads(grads, spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

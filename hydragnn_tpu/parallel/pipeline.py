@@ -305,7 +305,7 @@ def make_pipelined_forward(
 
 def make_pipelined_train_step(
     model: HydraModel, optimizer, mesh: Mesh, n_micro: int,
-    compute_dtype=jnp.float32, norm: str = "batch",
+    compute_dtype=jnp.float32, norm: str = "batch", loss_scale=None,
 ):
     """Jitted pipelined train step: (state, microbatches[M, ...]) ->
     (state, metrics). Loss is the graph-weighted mean over microbatches,
@@ -313,8 +313,14 @@ def make_pipelined_train_step(
     ``norm="batch"``, feature-norm RUNNING stats update too: one EMA step
     per microbatch, microbatch-averaged — the same semantics as the
     data-parallel step's replica-mean update, so a pipelined checkpoint
-    evaluates/fine-tunes identically on the data-parallel path."""
+    evaluates/fine-tunes identically on the data-parallel path.
+
+    ``loss_scale`` as in ``train.step._make_step_impl`` (static fp16-class
+    scaling; None/1 keeps the historical program byte-for-byte): the scaled
+    loss feeds the backward pass, the fp32-cast grads divide the scale back
+    out, and metrics report the UNSCALED loss via aux."""
     collect = norm == "batch"
+    loss_scale = None if not loss_scale or float(loss_scale) == 1.0 else float(loss_scale)
     encode = make_pipelined_forward(model, mesh, n_micro, norm=norm,
                                     collect_stats=collect)
     conv_cls = CONV_REGISTRY[model.spec.mpnn_type]
@@ -347,19 +353,31 @@ def make_pipelined_train_step(
 
         tots, tasks, ngs = jax.vmap(per_micro)(inv, equiv, c_mb, mb)
         denom = jnp.maximum(ngs.sum(), 1.0)
-        return tots.sum() / denom, (tasks.sum(axis=0) / denom, ngs.sum(),
-                                    new_stats)
+        loss = tots.sum() / denom
+        aux = (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
+        if loss_scale is not None:
+            # differentiate the scaled loss; the unscaled one rides out via
+            # aux so metrics never see the scale
+            return loss * loss_scale, (loss,) + aux
+        return loss, aux
 
     from ..train.step import donate_state_argnums as _donate
 
     @partial(jax.jit, donate_argnums=_donate())
     def train_step(state: TrainState, mb: GraphBatch):
-        (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
+        (loss, aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.batch_stats, mb)
         from ..train.step import freeze_conv_grads
 
-        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
+        grads = _cast_floats(grads, jnp.float32)
+        if loss_scale is not None:
+            # un-scale AFTER the fp32 cast (2^k scales divide back exactly)
+            loss, tasks, ng, new_stats = aux
+            grads = jax.tree.map(lambda g: g / loss_scale, grads)
+        else:
+            tasks, ng, new_stats = aux
+        grads = freeze_conv_grads(grads, model.spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                                   state.params)
         new_params = optax.apply_updates(state.params, updates)

@@ -174,15 +174,24 @@ def merge_replica_stats(new_stats, node_counts):
 
 
 def make_parallel_train_step(
-    model: HydraModel, optimizer, mesh: Mesh, compute_dtype=jnp.float32
+    model: HydraModel, optimizer, mesh: Mesh, compute_dtype=jnp.float32,
+    loss_scale=None,
 ):
     """Jitted SPMD train step: (state, stacked_batch[D, ...]) -> (state, metrics).
 
     Dispatches to the MLIP (energy+force) loss when the spec enables
     interatomic potentials — same contract as the single-device path.
+
+    ``loss_scale`` as in ``train.step._make_step_impl`` (static fp16-class
+    scaling; None/1 keeps the historical program byte-for-byte): the scaled
+    loss feeds the backward pass, the fp32-cast grads divide the scale back
+    out, and metrics report the UNSCALED loss via aux.
     """
     if model.spec.enable_interatomic_potential:
-        return _make_parallel_mlip_train_step(model, optimizer, mesh, compute_dtype)
+        return _make_parallel_mlip_train_step(
+            model, optimizer, mesh, compute_dtype, loss_scale
+        )
+    loss_scale = None if not loss_scale or float(loss_scale) == 1.0 else float(loss_scale)
 
     def loss_fn(params, batch_stats, batches: GraphBatch, dropout_rng):
         c_params = _cast_floats(params, compute_dtype)
@@ -212,17 +221,29 @@ def make_parallel_train_step(
         # running stats: node-count-weighted replica merge (reference
         # default replica averaging, with fill replicas at zero weight)
         new_stats = merge_replica_stats(new_stats, nws)
-        return loss, (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
+        aux = (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
+        if loss_scale is not None:
+            # differentiate the scaled loss; the unscaled one rides out via
+            # aux so metrics never see the scale
+            return loss * loss_scale, (loss,) + aux
+        return loss, aux
 
     @partial(jax.jit, donate_argnums=_donate())
     def train_step(state: TrainState, batches: GraphBatch):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
-        (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
+        (loss, aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.batch_stats, batches, dropout_rng)
         from ..train.step import freeze_conv_grads
 
-        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
+        grads = _cast_floats(grads, jnp.float32)
+        if loss_scale is not None:
+            # un-scale AFTER the fp32 cast (2^k scales divide back exactly)
+            loss, tasks, ng, new_stats = aux
+            grads = jax.tree.map(lambda g: g / loss_scale, grads)
+        else:
+            tasks, ng, new_stats = aux
+        grads = freeze_conv_grads(grads, model.spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
@@ -311,14 +332,18 @@ def make_parallel_mlip_eval_step(model: HydraModel, mesh: Mesh, compute_dtype=jn
 
 
 def _make_parallel_mlip_train_step(
-    model: HydraModel, optimizer, mesh: Mesh, compute_dtype=jnp.float32
+    model: HydraModel, optimizer, mesh: Mesh, compute_dtype=jnp.float32,
+    loss_scale=None,
 ):
-    """SPMD MLIP step: per-device inner force grad, global outer param grad."""
+    """SPMD MLIP step: per-device inner force grad, global outer param grad.
+    ``loss_scale`` scales only the OUTER (param) objective — the inner force
+    grad must stay in physical units, since forces feed the loss itself."""
     from ..models.mlip import energy_force_loss, validate_mlip_spec
     from ..graphs import segment
 
     spec = model.spec
     validate_mlip_spec(spec)
+    loss_scale = None if not loss_scale or float(loss_scale) == 1.0 else float(loss_scale)
 
     def loss_fn(params, batch_stats, batches: GraphBatch, dropout_rng):
         c_params = _cast_floats(params, compute_dtype)
@@ -360,17 +385,27 @@ def _make_parallel_mlip_train_step(
         )(c_batches, batches, dev_rngs)
         denom = jnp.maximum(ngs.sum(), 1.0)
         new_stats = merge_replica_stats(new_stats, nws)
-        return tots.sum() / denom, (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
+        loss = tots.sum() / denom
+        aux = (tasks.sum(axis=0) / denom, ngs.sum(), new_stats)
+        if loss_scale is not None:
+            return loss * loss_scale, (loss,) + aux
+        return loss, aux
 
     @partial(jax.jit, donate_argnums=_donate())
     def train_step(state: TrainState, batches: GraphBatch):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(0), state.step)
-        (loss, (tasks, ng, new_stats)), grads = jax.value_and_grad(
+        (loss, aux), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.batch_stats, batches, dropout_rng)
         from ..train.step import freeze_conv_grads
 
-        grads = freeze_conv_grads(_cast_floats(grads, jnp.float32), model.spec)
+        grads = _cast_floats(grads, jnp.float32)
+        if loss_scale is not None:
+            loss, tasks, ng, new_stats = aux
+            grads = jax.tree.map(lambda g: g / loss_scale, grads)
+        else:
+            tasks, ng, new_stats = aux
+        grads = freeze_conv_grads(grads, model.spec)
         updates, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

@@ -1,0 +1,26 @@
+"""Streaming bulk inference ("screening"): plan a whole sample store as
+full-bucket blocks, drive warmed AOT executables over them with
+double-buffered staging, keep the ranked top-k, resume exactly after
+preemption. See ``screen.planner`` (layout) and ``screen.engine``
+(execution)."""
+
+from .config import (
+    ScreeningConfig,
+    screening_config_defaults,
+    screening_config_from,
+)
+from .engine import BulkScreener, ScreenEntry, ScreenResult
+from .planner import ScreenBlock, ScreenPlan, plan_fingerprint, plan_screen
+
+__all__ = [
+    "BulkScreener",
+    "ScreenBlock",
+    "ScreenEntry",
+    "ScreenPlan",
+    "ScreenResult",
+    "ScreeningConfig",
+    "plan_fingerprint",
+    "plan_screen",
+    "screening_config_defaults",
+    "screening_config_from",
+]

@@ -1,0 +1,345 @@
+"""Double-buffered bulk-screening executor over AOT predict executables.
+
+Bulk inference (screen a large library, keep the top-k) through the serving
+tier would pay per-request admission, coalescing timers, and queue locks on
+every graph — machinery built for latency SLOs a screen does not have. This
+engine bypasses the request plane entirely: the planner
+(``screen.planner``) lays the whole stream out as full-bucket blocks, and
+the executor drives one warmed per-(model, bucket) AOT executable per block
+while a background thread fetches + collates the NEXT block(s) — device
+compute and host-side staging overlap, the same double-buffering contract
+as ``train.superstep``.
+
+Exactness: scores come from the SAME ``Predictor`` core and the SAME
+``serving_collate`` canonical meta as ``run_prediction`` / the serving tier,
+so for composition-identical batches the ranked scores are bit-identical to
+the offline evaluator (fp32, same backend). Steady state is zero-recompile
+by construction — every block shape is drawn from the warmed bucket table
+(``tests/test_screen.py`` pins this with the strict compile sentinel).
+
+Resume: after every scored block the engine atomically rewrites a position
+sidecar (``screen_meta.json`` — the PR 3/4 sidecar pattern). The plan is a
+pure function of its inputs, so a preempted screen re-plans, verifies the
+sidecar's plan fingerprint, skips ``blocks_done`` blocks, and continues:
+zero graphs lost, zero scored twice, and the final ranked top-k is
+bit-identical to an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+from .. import telemetry as tel
+from ..graphs.batching import PadSpec, background_iter
+from ..serve.batcher import serving_collate
+from ..serve.predictor import Predictor
+from .config import ScreeningConfig
+from .planner import ScreenPlan, plan_screen
+
+SIDECAR_VERSION = 1
+
+
+class ScreenEntry(NamedTuple):
+    index: int  # global sample index
+    score: float  # fp32 value (json round-trips it exactly)
+    variance: float | None  # ensemble member variance, None w/o ensemble
+    trusted: bool  # False when variance exceeds the configured ceiling
+
+
+class ScreenResult(NamedTuple):
+    topk: list  # list[ScreenEntry], (score desc, index asc)
+    completed: bool  # False when interrupted (preemption requested)
+    blocks_done: int  # blocks scored, cumulative across resumes
+    graphs_done: int  # graphs scored, cumulative across resumes
+    resumed_from: int  # blocks skipped on entry (0 = fresh run)
+    elapsed_s: float  # this invocation's wall time
+    graphs_per_sec: float  # this invocation's throughput
+
+
+def _rank(entries: Sequence[ScreenEntry], k: int) -> list:
+    """(score desc, index asc) — total order, so ranking is deterministic
+    and an interrupted+resumed screen reproduces it bit-for-bit."""
+    return sorted(entries, key=lambda t: (-t.score, t.index))[:k]
+
+
+class BulkScreener:
+    """Predictor + warmed bucket table + top-k accumulator.
+
+    ``pop_state``: optional ``train.population.PopulationState`` — scores
+    stay single-model (``predictor.state``) for bit-identity with
+    ``run_prediction``; the ensemble only contributes a per-graph member
+    VARIANCE, and scores whose variance exceeds
+    ``cfg.ensemble_variance_max`` are flagged untrusted, not dropped."""
+
+    def __init__(self, predictor: Predictor, buckets: Sequence[PadSpec],
+                 example, cfg: ScreeningConfig | None = None, pop_state=None):
+        self.predictor = predictor
+        self.buckets = sorted(buckets, key=lambda p: p.as_tuple())
+        self.example = example
+        self.cfg = (cfg or ScreeningConfig()).validate()
+        self.pop_state = pop_state
+        kind, _col, dim = predictor.cols[self.cfg.score_head]
+        if kind != "graph":
+            raise ValueError(
+                f"Screening.score_head={self.cfg.score_head} is a {kind!r} "
+                "head; screening ranks per-graph scores, so the score head "
+                "must be a graph head"
+            )
+        if self.cfg.score_col >= dim:
+            raise ValueError(
+                f"Screening.score_col={self.cfg.score_col} out of range for "
+                f"head {self.cfg.score_head} (dim {dim})"
+            )
+        self.executables: dict = {}
+        self.executables_ens: dict = {}
+        self._ens_step = None
+        self._lock = threading.Lock()
+        # written by the background staging thread, read by the consumer /
+        # stats(); never touched lock-free
+        self.prefetch_stats = {  # guarded-by: _lock
+            "blocks_staged": 0, "stage_s": 0.0,
+        }
+
+    # -- warm-up -------------------------------------------------------------
+
+    def warm(self, verify: bool = True) -> dict:
+        """AOT-lower + compile the predict program once per bucket (and the
+        vmapped ensemble variant when a population is attached); optionally
+        verify a dummy pass through every executable is lowering-free."""
+        from ..analysis.sentinel import no_recompile
+        from ..serve.server import _dummy_sample
+        from ..utils.compile_cache import (
+            aot_compile,
+            enable_compile_cache,
+            shape_structs,
+        )
+
+        enable_compile_cache()
+        if self.pop_state is not None and self._ens_step is None:
+            import jax
+
+            # PR 5 population idiom: one program evaluates every member
+            self._ens_step = jax.jit(
+                jax.vmap(self.predictor.predict_step, in_axes=(0, None))
+            )
+        report = {}
+        dummy = _dummy_sample(self.example)
+        for pad in self.buckets:
+            batch = serving_collate([dummy], pad)
+            t0 = time.perf_counter()
+            self.executables[pad.as_tuple()] = aot_compile(
+                self.predictor.predict_step,
+                self.predictor.state,
+                shape_structs(batch),
+            )
+            if self._ens_step is not None:
+                self.executables_ens[pad.as_tuple()] = aot_compile(
+                    self._ens_step, self.pop_state.state, shape_structs(batch)
+                )
+            report[repr(pad)] = round(time.perf_counter() - t0, 4)
+        if verify:
+            with no_recompile(0, what="screening warm-up verify"):
+                for pad in self.buckets:
+                    b = serving_collate([dummy], pad)
+                    self.executables[pad.as_tuple()](self.predictor.state, b)
+                    exe = self.executables_ens.get(pad.as_tuple())
+                    if exe is not None:
+                        exe(self.pop_state.state, b)
+        return report
+
+    # -- sidecar (exact-resume position record) ------------------------------
+
+    @staticmethod
+    def _read_sidecar(path: str) -> dict | None:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    @staticmethod
+    def _write_sidecar(path: str, obj: dict) -> None:
+        # atomic replace (train/checkpoint.py idiom): a SIGKILL mid-write
+        # leaves the previous consistent sidecar, never a torn one
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+
+    # -- the screen itself ---------------------------------------------------
+
+    def _fetch(self, store, indices: np.ndarray, bulk: bool) -> list:
+        if bulk and hasattr(store, "fetch_many"):
+            # cache-bypassing bulk wire op: one framed request per span per
+            # replica set, no LRU pollution (datasets.sharded.fetch_many)
+            return store.fetch_many(indices)
+        if hasattr(store, "fetch"):
+            return store.fetch(indices)
+        return [store[int(i)] for i in indices]
+
+    def _scores(self, blk, batch) -> np.ndarray:
+        exe = self.executables.get(blk.pad.as_tuple())
+        out = self.predictor.outputs(batch, step=exe)
+        kind_mask = np.asarray(batch.graph_mask) > 0
+        head = np.asarray(out[self.cfg.score_head])
+        return head[kind_mask][:, self.cfg.score_col].astype(np.float32)
+
+    def _variances(self, blk, batch) -> np.ndarray | None:
+        exe = self.executables_ens.get(blk.pad.as_tuple())
+        if exe is None:
+            return None
+        out = exe(self.pop_state.state, batch)
+        if self.predictor.spec.var_output:
+            out = out[0]
+        head = np.asarray(out[self.cfg.score_head])  # [M, G, dim]
+        mask = np.asarray(batch.graph_mask) > 0
+        member_scores = head[:, mask, self.cfg.score_col]
+        return member_scores.var(axis=0).astype(np.float32)
+
+    def screen(self, store, indices=None, *, meta_path: str | None = None,
+               resume: bool = False, preempt=None,
+               bulk: bool = True) -> ScreenResult:
+        """Score ``indices`` of ``store`` (default: the whole store), return
+        the ranked top-k.
+
+        ``meta_path``: where the resume sidecar lives; None disables
+        position tracking. ``resume=True`` continues from that sidecar
+        (fresh-start when it does not exist). ``preempt``: anything with a
+        ``requested`` property or method
+        (``resilience.preempt.PreemptionHandler``) —
+        checked between blocks; when it fires the engine finalizes the
+        sidecar and returns ``completed=False``. ``bulk=False`` forces the
+        per-batch ``fetch`` path (the bench's naive arm)."""
+        cfg = self.cfg
+        if indices is None:
+            indices = range(len(store))
+        plan = plan_screen(store, indices, self.buckets,
+                           bucket_major=cfg.bucket_major)
+        entries: list = []
+        start_block = 0
+        graphs_done = 0
+        if resume and meta_path:
+            side = self._read_sidecar(meta_path)
+            if side is not None:
+                if side.get("fingerprint") != plan.fingerprint:
+                    raise ValueError(
+                        "screen resume refused: sidecar plan fingerprint "
+                        f"{side.get('fingerprint')!r} does not match the "
+                        f"recomputed plan {plan.fingerprint!r} — the store, "
+                        "index set, or bucket table changed since the "
+                        "interrupted run"
+                    )
+                start_block = int(side["blocks_done"])
+                graphs_done = int(side["graphs_done"])
+                entries = [
+                    ScreenEntry(int(i), float(s),
+                                None if v is None else float(v), bool(tr))
+                    for i, s, v, tr in side["topk"]
+                ]
+                tel.emit("screen_resume", blocks_done=start_block,
+                         graphs_done=graphs_done,
+                         fingerprint=plan.fingerprint)
+
+        def sidecar_obj(completed: bool, blocks_done: int) -> dict:
+            return {
+                "version": SIDECAR_VERSION,
+                "fingerprint": plan.fingerprint,
+                "blocks_done": blocks_done,
+                "graphs_done": graphs_done,
+                "completed": completed,
+                "topk": [
+                    [e.index, e.score, e.variance, e.trusted]
+                    for e in entries
+                ],
+            }
+
+        def produce():
+            for bi in range(start_block, len(plan.blocks)):
+                blk = plan.blocks[bi]
+                t0 = time.perf_counter()
+                samples = self._fetch(store, blk.indices, bulk)
+                batch = serving_collate(samples, blk.pad)
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    self.prefetch_stats["blocks_staged"] += 1
+                    self.prefetch_stats["stage_s"] += dt
+                yield bi, blk, batch
+
+        # prefetch>0: staging (fetch + collate) runs in a daemon thread up
+        # to ``prefetch`` blocks ahead of the device — the double-buffer.
+        # prefetch=0 is the fully synchronous naive arm (identical scores).
+        it = (background_iter(produce(), depth=cfg.prefetch)
+              if cfg.prefetch > 0 else produce())
+        var_max = cfg.ensemble_variance_max
+        blocks_done = start_block
+        graphs_this_run = 0
+        interrupted = False
+        t_start = time.perf_counter()
+        try:
+            for bi, blk, batch in it:
+                t0 = time.perf_counter()
+                scores = self._scores(blk, batch)
+                variances = self._variances(blk, batch)
+                for j, idx in enumerate(blk.indices):
+                    var = None if variances is None else float(variances[j])
+                    trusted = not (
+                        var is not None and var_max > 0 and var > var_max
+                    )
+                    entries.append(
+                        ScreenEntry(int(idx), float(scores[j]), var, trusted)
+                    )
+                entries = _rank(entries, cfg.topk)
+                graphs_done += len(blk.indices)
+                graphs_this_run += len(blk.indices)
+                blocks_done = bi + 1
+                tel.emit(
+                    "screen_block", block=bi, bucket=list(blk.pad.as_tuple()),
+                    n_graphs=len(blk.indices),
+                    ms=round((time.perf_counter() - t0) * 1e3, 3),
+                )
+                if meta_path and (
+                    blocks_done == len(plan.blocks)
+                    or (blocks_done - start_block) % cfg.checkpoint_every == 0
+                ):
+                    self._write_sidecar(
+                        meta_path,
+                        sidecar_obj(blocks_done == len(plan.blocks),
+                                    blocks_done),
+                    )
+                if preempt is not None and blocks_done < len(plan.blocks):
+                    # duck-typed: PreemptionHandler exposes ``requested`` as
+                    # a property; test doubles may make it a method
+                    req = preempt.requested
+                    if callable(req):
+                        req = req()
+                    if req:
+                        interrupted = True
+                        break
+        finally:
+            if hasattr(it, "close"):
+                it.close()  # stop the staging thread promptly
+        elapsed = time.perf_counter() - t_start
+        if interrupted and meta_path:
+            # a preemption between checkpoints must still persist the exact
+            # position — that is the whole resume contract
+            self._write_sidecar(meta_path, sidecar_obj(False, blocks_done))
+        return ScreenResult(
+            topk=list(entries),
+            completed=blocks_done >= len(plan.blocks),
+            blocks_done=blocks_done,
+            graphs_done=graphs_done,
+            resumed_from=start_block,
+            elapsed_s=elapsed,
+            graphs_per_sec=(
+                round(graphs_this_run / elapsed, 3) if elapsed > 0 else 0.0
+            ),
+        )
+
+
+__all__ = ["BulkScreener", "ScreenEntry", "ScreenPlan", "ScreenResult"]

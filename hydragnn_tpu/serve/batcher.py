@@ -21,6 +21,7 @@ trade on TPU.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Sequence
 
@@ -34,6 +35,25 @@ from .admission import (
 )
 
 
+@functools.lru_cache(maxsize=256)
+def _canonical_meta_cached(key: tuple, node_cap: int | None) -> BatchMeta:
+    # keyed on (as_tuple(), node_cap) — as_tuple() alone would miss
+    # node_cap, which the bound below reads. BatchMeta is an immutable
+    # NamedTuple of ints/bools, so sharing ONE instance per bucket across
+    # every collate call is safe (and keeps treedefs trivially identical).
+    if node_cap:
+        # a user attn_cap below node_cap is deliberately NOT used here:
+        # serving pins ONE cert level per bucket (no per-batch outlier
+        # fallback), and only node_cap covers every admissible graph
+        bound = node_cap
+    else:
+        bound = max(1 << max(key[0] - 1, 0).bit_length(), 8)
+    return BatchMeta(
+        gs_fits=False, recv_fits=False, send_fits=False, pool_fits=False,
+        max_n_node=int(bound), attn_fits=False,
+    )
+
+
 def canonical_meta(pad: PadSpec) -> BatchMeta:
     """The ONE ``BatchMeta`` every served batch of ``pad`` carries.
 
@@ -45,18 +65,13 @@ def canonical_meta(pad: PadSpec) -> BatchMeta:
     with more nodes than ``max_n_node`` would be certified under a false
     bound (GPS dense blocks would silently truncate it), so the micro-batcher
     sheds such requests as ``OversizeError`` — outside the size envelope the
-    endpoint's programs were certified for."""
-    if pad.node_cap:
-        # a user attn_cap below node_cap is deliberately NOT used here:
-        # serving pins ONE cert level per bucket (no per-batch outlier
-        # fallback), and only node_cap covers every admissible graph
-        bound = pad.node_cap
-    else:
-        bound = max(1 << max(pad.n_node - 1, 0).bit_length(), 8)
-    return BatchMeta(
-        gs_fits=False, recv_fits=False, send_fits=False, pool_fits=False,
-        max_n_node=int(bound), attn_fits=False,
-    )
+    endpoint's programs were certified for.
+
+    Memoized per bucket: the meta depends ONLY on the bucket (never on the
+    batch contents or graph count), and ``serving_collate`` sits on the
+    dispatch hot path — recomputing the bound per call was pure overhead,
+    and the bulk-screening executor calls it once per block."""
+    return _canonical_meta_cached(pad.as_tuple(), pad.node_cap)
 
 
 def serving_collate(samples: Sequence[GraphSample], pad: PadSpec) -> GraphBatch:

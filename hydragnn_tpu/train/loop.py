@@ -625,7 +625,8 @@ def train_validate_test(
             or mesh.shape[STAGE_AXIS]
         )
         train_step = make_pipelined_train_step(
-            model, optimizer, mesh, n_micro=n_micro, compute_dtype=precision
+            model, optimizer, mesh, n_micro=n_micro, compute_dtype=precision,
+            loss_scale=loss_scale,
         )
         eval_step = make_pipelined_eval_step(
             model, mesh, n_micro=n_micro, compute_dtype=precision
@@ -639,7 +640,8 @@ def train_validate_test(
         from ..parallel.step import make_parallel_eval_step, make_parallel_train_step
 
         train_step = make_parallel_train_step(
-            model, optimizer, mesh, compute_dtype=precision
+            model, optimizer, mesh, compute_dtype=precision,
+            loss_scale=loss_scale,
         )
         if model.spec.enable_interatomic_potential:
             # vmapped SPMD MLIP eval — one program over all device shards
@@ -653,23 +655,23 @@ def train_validate_test(
         # MLIP path: energy + per-atom energy + jax.grad forces in the loss
         from ..models.mlip import make_mlip_eval_step, make_mlip_train_step
 
-        train_step = make_mlip_train_step(model, optimizer, compute_dtype=precision)
+        train_step = make_mlip_train_step(
+            model, optimizer, compute_dtype=precision, loss_scale=loss_scale
+        )
         eval_step = make_mlip_eval_step(model, compute_dtype=precision)
     else:
         train_step = make_train_step(
             model, optimizer, compute_dtype=precision, loss_scale=loss_scale
         )
         eval_step = make_eval_step(model, compute_dtype=precision)
-    if loss_scale is not None and not (
-        mesh is None and not model.spec.enable_interatomic_potential
-    ):
-        # the scaling hook lives in the single-device step builder; the
-        # mesh/MLIP/pipeline factories ignore it — say so instead of
-        # silently training unscaled fp16
+    if loss_scale is not None and mesh is not None and edge_sharded:
+        # the scaling hook is wired into the single-device, mesh, MLIP and
+        # pipeline step factories; edge-sharded long-context mode is the one
+        # remaining gap — say so instead of silently training unscaled fp16
         print_distributed(
             verbosity,
-            f"Training.loss_scale={loss_scale} is only wired into the "
-            "single-device train step; this mode trains UNSCALED",
+            f"Training.loss_scale={loss_scale} is not wired into the "
+            "edge-sharded train step; this mode trains UNSCALED",
         )
 
     # Non-finite step guard (resilience/guard.py): wrap the train step —

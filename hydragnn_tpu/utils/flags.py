@@ -217,6 +217,20 @@ FLEET_CACHE_BYTES = _register(Flag(
     "graph is answered from the router, byte-identical to replica "
     "compute, at zero replica cost."))
 
+# -- bulk screening (hydragnn_tpu.screen) ------------------------------------
+SCREEN_PREFETCH = _register(Flag(
+    "HYDRAGNN_SCREEN_PREFETCH", "int", None,
+    "Blocks the bulk-screening executor stages ahead of the device "
+    "(overrides Screening.prefetch, default 2): a background thread "
+    "fetches + collates the next block(s) while the current one computes. "
+    "=0 runs fully synchronous — the 'naive' arm the screen_throughput_ab "
+    "bench times against; scores are identical either way."))
+SCREEN_TOPK = _register(Flag(
+    "HYDRAGNN_SCREEN_TOPK", "int", None,
+    "Ranked candidates a bulk screen keeps (overrides Screening.topk, "
+    "default 16). Ordering is (score desc, index asc) — deterministic, so "
+    "an interrupted-and-resumed screen reports the bit-identical list."))
+
 # -- precision --------------------------------------------------------------
 PRECISION = _register(Flag(
     "HYDRAGNN_PRECISION", "str", None,

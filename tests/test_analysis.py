@@ -227,6 +227,17 @@ def test_telemetry_plane_shape_is_clean():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_screen_planner_shape_is_clean():
+    """The bulk-screening engine's shape (hydragnn_tpu/screen: an owned
+    daemon staging thread handing fetched+collated blocks to the consumer
+    through a bounded queue, stats behind one lock with guarded-by
+    declarations, monotonic block timings, precompiled executables called
+    per block, tmp-then-replace sidecar writes) is sanctioned host code:
+    every rule — GL101/GL105/GL106 above all — must stay silent on it."""
+    findings = analyze([str(FIXTURES / "screen_planner_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_gl003_scan_folded_steps_are_clean():
     """lax.scan-folded supersteps (train/superstep.py's pattern: one jitted
     scan built outside the loop, dispatched per block) are the sanctioned
@@ -382,6 +393,7 @@ def test_guarded_by_annotations_present_in_threaded_modules():
         "hydragnn_tpu/utils/wire.py",
         "hydragnn_tpu/datasets/sharded.py",
         "hydragnn_tpu/resilience/watchdog.py",
+        "hydragnn_tpu/screen/engine.py",
     ):
         text = (REPO / rel).read_text()
         assert "# guarded-by:" in text, f"{rel} lost its guarded-by annotations"

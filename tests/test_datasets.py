@@ -564,6 +564,67 @@ def test_sharded_store_size_table_and_misroute_guard(tmp_path):
         s1.close()
 
 
+def test_sharded_store_fetch_many_bypasses_cache(tmp_path):
+    """ISSUE 17 satellite: ``fetch_many`` is the bulk-screening wire op —
+    same spans/failover as ``fetch``, but touch-once semantics: it must
+    never populate (or read) the LRU cache, while ``fetch``'s own caching
+    surface stays intact alongside it."""
+    import numpy as np
+
+    from hydragnn_tpu.datasets import deterministic_graph_data
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+
+    samples = deterministic_graph_data(number_configurations=20, seed=4)
+    p0, p1 = str(tmp_path / "shard0.gpk"), str(tmp_path / "shard1.gpk")
+    PackedWriter(samples[:12], p0)
+    PackedWriter(samples[12:], p1)
+    s0 = ShardedStore(p0, 0, 12, peers=[("127.0.0.1", 0, 0, 12)])
+    s1 = ShardedStore(
+        p1, 12, 20,
+        peers=[("127.0.0.1", s0.server.port, 0, 12),
+               ("127.0.0.1", 0, 12, 20)],
+    )
+    s0.peers = [("127.0.0.1", s0.server.port, 0, 12),
+                ("127.0.0.1", s1.server.port, 12, 20)]
+    s0.total = s1.total = 20
+
+    try:
+        # mixed local/remote span, order preserved, values identical
+        got = s0.fetch_many(list(range(8, 16)))
+        assert s0.remote_fetches == 4  # 12..15 crossed the wire
+        for i, s in zip(range(8, 16), got):
+            np.testing.assert_array_equal(
+                np.asarray(s.x), np.asarray(samples[i].x)
+            )
+        assert len(s0._cache) == 0  # bulk reads never touch the LRU
+
+        # touch-once: an identical second call pays the wire again (no
+        # cache means no hits — by design)
+        s0.fetch_many([12, 13])
+        assert s0.remote_fetches == 6
+
+        # duplicate remote indices are deduped on the wire (one decode)
+        # yet returned as independent instances (the same isolation
+        # contract as fetch); local mmap views may be shared
+        a, b = s0.fetch_many([15, 15])
+        assert s0.remote_fetches == 7
+        a.x[:] = -123.0
+        np.testing.assert_array_equal(np.asarray(b.x), np.asarray(samples[15].x))
+
+        # the per-sample surface is untouched: fetch still caches, and
+        # fetch_many leaves those cached entries alone
+        s0.fetch([16])
+        assert len(s0._cache) == 1 and s0.remote_fetches == 8
+        s0.fetch_many([16])
+        assert len(s0._cache) == 1 and s0.remote_fetches == 9
+        s0.fetch([16])  # still a cache hit
+        assert s0.remote_fetches == 9
+    finally:
+        s0.close()
+        s1.close()
+
+
 def test_sharded_wire_codec_roundtrip_and_fuzz():
     """The binary wire codec: exact round-trip for every dtype/shape class
     it ships, and NO malformed input — truncations, bit flips, garbage —

@@ -297,3 +297,112 @@ def test_bf16_checkpoint_fp32_on_disk_and_bitexact_resume(tmp_path):
     assert float(m_cont["loss"]) == float(m_base["loss"])
     for a, b in zip(jax.tree.leaves(base), jax.tree.leaves(cont)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- loss-scale threading: mesh / MLIP / pipeline step factories --------------
+
+
+def test_mlip_loss_scale_matches_unscaled_exactly():
+    """The MLIP (grad-of-grad) step with loss_scale=2^k must be byte-
+    identical to unscaled in fp32: only the OUTER param objective is
+    scaled; the inner force gradient stays in physical units because the
+    forces it produces feed the loss itself."""
+    from test_forces import MLIP_CONFIG
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.datasets.lennard_jones import lennard_jones_data
+    from hydragnn_tpu.graphs.batching import collate, compute_pad_spec
+    from hydragnn_tpu.models import create_model_config
+    from hydragnn_tpu.models.mlip import make_mlip_train_step
+    from hydragnn_tpu.preprocess import apply_variables_of_interest
+    from hydragnn_tpu.train import select_optimizer
+
+    # smallest program that exercises the scaled grad-of-grad path: one
+    # conv layer, narrow widths, a 2-graph batch (tier-1 time budget)
+    cfg = copy.deepcopy(MLIP_CONFIG)
+    arch = cfg["NeuralNetwork"]["Architecture"]
+    arch["num_conv_layers"] = 1
+    arch["hidden_dim"] = 8
+    arch["output_heads"]["node"]["dim_headlayers"] = [8, 8]
+    samples = lennard_jones_data(
+        number_configurations=4, cells_per_dim=2, seed=3
+    )
+    samples = apply_variables_of_interest(samples, cfg)
+    cfg = update_config(cfg, samples)
+    model = create_model_config(cfg)
+    pad = compute_pad_spec(samples, 2)
+    batch = jax.tree.map(jnp.asarray, collate(samples[:2], pad))
+    opt = select_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    state = create_train_state(model, opt, batch)
+    plain = make_mlip_train_step(model, opt)
+    scaled = make_mlip_train_step(model, opt, loss_scale=1024.0)
+    s_p, m_p = plain(state, batch)
+    s_s, m_s = scaled(state, batch)
+    assert float(m_p["loss"]) == float(m_s["loss"])  # aux-carried, unscaled
+    np.testing.assert_array_equal(
+        np.asarray(m_p["tasks_loss"]), np.asarray(m_s["tasks_loss"])
+    )
+    for a, b in zip(jax.tree.leaves(s_p.params), jax.tree.leaves(s_s.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_parallel_loss_scale_matches_unscaled_exactly():
+    """Same transparency gate for the data-mesh step (slow-marked up front:
+    two 8-device SPMD step compiles)."""
+    from test_parallel import setup_model
+
+    from hydragnn_tpu.parallel import (
+        make_mesh,
+        make_parallel_train_step,
+        put_batch,
+        shard_state,
+        stack_device_batches,
+    )
+    from hydragnn_tpu.train import select_optimizer  # noqa: F401 (idiom)
+
+    model, opt, batches = setup_model()
+    mesh = make_mesh()
+    state0 = create_train_state(model, opt, batches[0])
+    sb = put_batch(stack_device_batches(batches[:8]), mesh)
+    plain = make_parallel_train_step(model, opt, mesh)
+    scaled = make_parallel_train_step(model, opt, mesh, loss_scale=1024.0)
+    s_p, m_p = plain(shard_state(state0, mesh), sb)
+    s_s, m_s = scaled(shard_state(state0, mesh), sb)
+    assert float(m_p["loss"]) == float(m_s["loss"])
+    np.testing.assert_array_equal(
+        np.asarray(m_p["tasks_loss"]), np.asarray(m_s["tasks_loss"])
+    )
+    for a, b in zip(jax.tree.leaves(s_p.params), jax.tree.leaves(s_s.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_pipeline_loss_scale_matches_unscaled_exactly():
+    """Same transparency gate for the GPipe step (slow-marked up front: two
+    4-stage pipeline step compiles)."""
+    import optax
+
+    from test_pipeline import setup as pipeline_setup
+
+    from hydragnn_tpu.parallel import stack_device_batches
+    from hydragnn_tpu.parallel.pipeline import (
+        make_pipeline_mesh,
+        make_pipelined_train_step,
+        put_microbatches,
+    )
+
+    model, batches = pipeline_setup(num_conv_layers=5, n_micro=4)
+    mesh = make_pipeline_mesh(4)
+    opt = optax.adamw(5e-3)
+    state0 = create_train_state(model, opt, batches[0])
+    mb = put_microbatches(stack_device_batches(batches), mesh)
+    plain = make_pipelined_train_step(model, opt, mesh, n_micro=4)
+    scaled = make_pipelined_train_step(
+        model, opt, mesh, n_micro=4, loss_scale=1024.0
+    )
+    s_p, m_p = plain(state0, mb)
+    s_s, m_s = scaled(state0, mb)
+    assert float(m_p["loss"]) == float(m_s["loss"])
+    for a, b in zip(jax.tree.leaves(s_p.params), jax.tree.leaves(s_s.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

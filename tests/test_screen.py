@@ -1,0 +1,527 @@
+"""Bulk-screening tests (ISSUE 17): planner layout, exact resume, steady-
+state zero-recompile, screened-vs-``run_prediction`` bit parity, SIGTERM
+preemption e2e, and the Screening config block / flags.
+
+The resume contract is proved twice, per the tier-1 budget rule:
+unit-cost with a fake store + fake predictor (no jax programs compiled at
+all), and one slow-marked e2e with the real model, warm AOT executables,
+and a real SIGTERM through ``resilience.preempt.PreemptionHandler``.
+"""
+
+import copy
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graphs.batching import compute_pad_buckets
+from hydragnn_tpu.screen import (
+    BulkScreener,
+    ScreeningConfig,
+    plan_screen,
+    screening_config_defaults,
+    screening_config_from,
+)
+
+from test_config import CI_CONFIG
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _threadsan(threadsan_module):
+    """The engine's staging thread + stats lock (and, in the slow e2e, the
+    store executor) run under the lock-order sanitizer; module teardown
+    asserts the observed acquisition graph is cycle-free."""
+    yield threadsan_module
+
+
+# -- unit-cost doubles (no jax program is ever built) -------------------------
+
+
+class NoBulkStore:
+    """Samples + per-index fetch accounting, WITHOUT ``fetch_many`` — the
+    engine must fall back to ``fetch``. ``sample_sizes`` answers from
+    metadata (like PackedDataset/ShardedStore), so planner tests can assert
+    content is never touched at plan time."""
+
+    def __init__(self, samples):
+        self.samples = list(samples)
+        self.fetch_counts = {}
+        self.bulk_calls = 0
+        self.fetch_calls = 0
+
+    def __len__(self):
+        return len(self.samples)
+
+    def sample_sizes(self, indices):
+        return np.asarray(
+            [(self.samples[int(i)].num_nodes, self.samples[int(i)].num_edges)
+             for i in indices],
+            np.int64,
+        )
+
+    def _grab(self, indices):
+        out = []
+        for i in map(int, indices):
+            self.fetch_counts[i] = self.fetch_counts.get(i, 0) + 1
+            out.append(self.samples[i])
+        return out
+
+    def fetch(self, indices):
+        self.fetch_calls += 1
+        return self._grab(indices)
+
+
+class FakeStore(NoBulkStore):
+    """NoBulkStore + the batched wire surface ShardedStore grew (ISSUE 17
+    satellite): the engine prefers this path when ``bulk=True``."""
+
+    def fetch_many(self, indices):
+        self.bulk_calls += 1
+        return self._grab(indices)
+
+
+class FakeSpec:
+    var_output = False
+
+
+class FakePredictor:
+    """Content-deterministic scores with zero compiled programs: a graph's
+    score is the sum of its node features (padding nodes are zero, so the
+    value is invariant to which bucket the graph lands in)."""
+
+    cols = [("graph", 0, 1)]
+    spec = FakeSpec()
+    predict_step = None
+    state = None
+
+    def outputs(self, batch, step=None):
+        seg = np.asarray(batch.batch)
+        xsum = np.asarray(batch.x, np.float32).sum(axis=1)
+        g = len(np.asarray(batch.graph_mask))
+        out = np.zeros((g, 1), np.float32)
+        np.add.at(out[:, 0], seg, xsum)
+        return [out]
+
+
+class StopAfter:
+    """Preemption double: fires after ``n`` between-block checks."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def requested(self):
+        self.n -= 1
+        return self.n < 0
+
+
+def _fake_samples(n=40, seed=7):
+    from hydragnn_tpu.datasets import deterministic_graph_data
+
+    return deterministic_graph_data(number_configurations=n, seed=seed)
+
+
+def _fake_screener(samples, **cfg_kw):
+    buckets = compute_pad_buckets(samples, 8, max_buckets=3)
+    cfg = ScreeningConfig(batch_size=8, **cfg_kw)
+    return BulkScreener(FakePredictor(), buckets, samples[0], cfg=cfg)
+
+
+# -- planner ------------------------------------------------------------------
+
+
+def test_plan_covers_every_graph_once_within_budget():
+    samples = _fake_samples()
+    buckets = compute_pad_buckets(samples, 8, max_buckets=3)
+    plan = plan_screen(samples, range(len(samples)), buckets)
+    covered = np.concatenate([b.indices for b in plan.blocks])
+    assert np.array_equal(np.sort(covered), np.arange(len(samples)))
+    table = {b.as_tuple() for b in buckets}
+    for blk in plan.blocks:
+        # every block shape is drawn from the warmed table (zero-recompile
+        # by construction) and its contents really fit the bucket
+        assert blk.pad.as_tuple() in table
+        tot_n = sum(samples[i].num_nodes for i in blk.indices)
+        tot_e = sum(samples[i].num_edges for i in blk.indices)
+        assert tot_n < blk.pad.n_node
+        assert tot_e <= blk.pad.n_edge
+        assert len(blk.indices) <= blk.pad.n_graph - 1
+    # tail blocks re-pad to the TOP bucket
+    top = buckets[-1].as_tuple()
+    for blk in plan.blocks[len(plan.blocks) - plan.n_tail_blocks:]:
+        assert blk.pad.as_tuple() == top
+
+
+def test_plan_bucket_major_groups_blocks_by_bucket():
+    samples = _fake_samples()
+    buckets = compute_pad_buckets(samples, 8, max_buckets=3)
+    plan = plan_screen(samples, range(len(samples)), buckets)
+    order = [b.as_tuple() for b in buckets]
+    body = plan.blocks[: len(plan.blocks) - plan.n_tail_blocks]
+    ranks = [order.index(b.pad.as_tuple()) for b in body]
+    assert ranks == sorted(ranks), "body blocks not bucket-major"
+    # stream order keeps blocks in close order instead, same block set
+    stream = plan_screen(samples, range(len(samples)), buckets,
+                         bucket_major=False)
+    key = lambda blocks: sorted(tuple(b.indices.tolist()) for b in blocks)
+    assert key(stream.blocks) == key(plan.blocks)
+    assert stream.fingerprint != plan.fingerprint
+
+
+def test_plan_is_deterministic_and_fingerprinted():
+    samples = _fake_samples()
+    buckets = compute_pad_buckets(samples, 8, max_buckets=3)
+    a = plan_screen(samples, range(len(samples)), buckets)
+    b = plan_screen(samples, range(len(samples)), buckets)
+    assert a.fingerprint == b.fingerprint
+    assert [x.indices.tolist() for x in a.blocks] == [
+        x.indices.tolist() for x in b.blocks
+    ]
+    c = plan_screen(samples, range(len(samples) - 1), buckets)
+    assert c.fingerprint != a.fingerprint
+
+
+def test_plan_never_touches_sample_content():
+    """Plan-time bucketing must stay metadata-only (over a ShardedStore a
+    content read would be one remote fetch per graph per plan)."""
+    samples = _fake_samples()
+
+    class SizesOnly(FakeStore):
+        def __getitem__(self, i):
+            raise AssertionError("planner touched sample content")
+
+    store = SizesOnly(samples)
+    buckets = compute_pad_buckets(samples, 8, max_buckets=3)
+    plan = plan_screen(store, range(len(store)), buckets)
+    assert sum(len(b.indices) for b in plan.blocks) == len(samples)
+    assert store.fetch_calls == 0 and store.bulk_calls == 0
+
+
+# -- engine: unit-cost exact resume ------------------------------------------
+
+
+def test_screen_resume_bitmatches_uninterrupted(tmp_path):
+    """Kill mid-stream, resume from the sidecar: the ranked top-k must
+    bit-match the uninterrupted run's, with every graph scored exactly
+    once across the two runs."""
+    samples = _fake_samples()
+    n = len(samples)
+    full = _fake_screener(samples, topk=n, prefetch=2).screen(
+        FakeStore(samples)
+    )
+    assert full.completed and full.graphs_done == n
+
+    scr = _fake_screener(samples, topk=n, prefetch=2)
+    mp = str(tmp_path / "screen_meta.json")
+    r1 = scr.screen(FakeStore(samples), meta_path=mp, preempt=StopAfter(3))
+    assert not r1.completed and 0 < r1.blocks_done
+    side = json.loads(open(mp).read())
+    assert side["blocks_done"] == r1.blocks_done and not side["completed"]
+
+    r2 = scr.screen(FakeStore(samples), meta_path=mp, resume=True)
+    assert r2.completed
+    assert r2.resumed_from == r1.blocks_done
+    assert [tuple(e) for e in r2.topk] == [tuple(e) for e in full.topk]
+    # zero lost, zero double-scored: with k = n the ranked list IS the full
+    # score table — every index exactly once
+    assert sorted(e.index for e in r2.topk) == list(range(n))
+    # the final sidecar records completion
+    assert json.loads(open(mp).read())["completed"]
+
+
+def test_screen_sync_arm_fetches_each_graph_exactly_once(tmp_path):
+    """prefetch=0 (the naive arm): interrupted + resumed runs together
+    fetch — and therefore score — every graph exactly once; the staged-
+    ahead refetch window only exists when prefetch > 0."""
+    samples = _fake_samples()
+    n = len(samples)
+    store = FakeStore(samples)
+    scr = _fake_screener(samples, topk=n, prefetch=0)
+    mp = str(tmp_path / "m.json")
+    r1 = scr.screen(store, meta_path=mp, preempt=StopAfter(2))
+    assert not r1.completed
+    r2 = scr.screen(store, meta_path=mp, resume=True)
+    assert r2.completed and r2.graphs_done == n
+    assert store.fetch_counts == {i: 1 for i in range(n)}
+    assert store.bulk_calls > 0 and store.fetch_calls == 0
+
+
+def test_screen_bulk_flag_selects_fetch_path():
+    samples = _fake_samples(16)
+    store = FakeStore(samples)
+    _fake_screener(samples, topk=4).screen(store, bulk=False)
+    assert store.bulk_calls == 0 and store.fetch_calls > 0
+    store2 = NoBulkStore(samples)  # no fetch_many at all
+    res = _fake_screener(samples, topk=4).screen(store2)
+    assert res.completed and store2.fetch_calls > 0
+
+
+def test_screen_resume_refuses_fingerprint_mismatch(tmp_path):
+    samples = _fake_samples(24)
+    scr = _fake_screener(samples, topk=4)
+    mp = str(tmp_path / "m.json")
+    scr.screen(FakeStore(samples), meta_path=mp, preempt=StopAfter(1))
+    with pytest.raises(ValueError, match="fingerprint"):
+        scr.screen(FakeStore(samples), indices=range(10), meta_path=mp,
+                   resume=True)
+
+
+def test_screen_sidecar_roundtrips_scores_exactly(tmp_path):
+    """json float round-trip is exact for fp32 values — the resume path's
+    restored top-k is bit-identical, not approximately equal."""
+    samples = _fake_samples(24)
+    scr = _fake_screener(samples, topk=8)
+    mp = str(tmp_path / "m.json")
+    res = scr.screen(FakeStore(samples), meta_path=mp)
+    side = json.loads(open(mp).read())
+    assert [(e.index, e.score) for e in res.topk] == [
+        (i, s) for i, s, _v, _t in side["topk"]
+    ]
+    for _i, s, _v, _t in side["topk"]:
+        assert float(np.float32(s)) == s  # round-trip landed ON an fp32 value
+
+
+def test_screen_telemetry_journal_records(tmp_path):
+    from hydragnn_tpu import telemetry as tel
+
+    samples = _fake_samples(24)
+    path = str(tmp_path / "journal.jsonl")
+    tel.open_journal(file=path)
+    try:
+        scr = _fake_screener(samples, topk=4)
+        mp = str(tmp_path / "m.json")
+        scr.screen(FakeStore(samples), meta_path=mp, preempt=StopAfter(1))
+        scr.screen(FakeStore(samples), meta_path=mp, resume=True)
+    finally:
+        tel.close_journal()
+    kinds = [r["kind"] for r in tel.read_journal(path)]
+    assert "screen_block" in kinds and "screen_resume" in kinds
+    blocks = [r for r in tel.read_journal(path) if r["kind"] == "screen_block"]
+    assert all({"block", "bucket", "n_graphs", "ms"} <= set(b) for b in blocks)
+
+
+# -- config block / flags -----------------------------------------------------
+
+
+def test_screening_config_block_validated_and_defaulted():
+    from hydragnn_tpu.config import update_config
+
+    samples = _fake_samples(8)
+    cfg = copy.deepcopy(CI_CONFIG)
+    cfg["Screening"] = {"topk": 5}
+    aug = update_config(cfg, samples)
+    # partial block keeps the caller's key and gains every default
+    assert aug["Screening"]["topk"] == 5
+    assert set(aug["Screening"]) == set(screening_config_defaults())
+
+    bad = copy.deepcopy(CI_CONFIG)
+    bad["Screening"] = {"topkk": 5}
+    with pytest.raises(ValueError, match="Screening"):
+        update_config(bad, samples)
+    bad["Screening"] = {"topk": 0}
+    with pytest.raises(ValueError, match="topk"):
+        update_config(bad, samples)
+    bad["Screening"] = {"prefetch": -1}
+    with pytest.raises(ValueError, match="prefetch"):
+        update_config(bad, samples)
+
+
+def test_screen_flags_override_config(monkeypatch):
+    monkeypatch.delenv("HYDRAGNN_SCREEN_TOPK", raising=False)
+    monkeypatch.delenv("HYDRAGNN_SCREEN_PREFETCH", raising=False)
+    cfg = screening_config_from({"Screening": {"topk": 7, "prefetch": 3}})
+    assert cfg.topk == 7 and cfg.prefetch == 3
+    monkeypatch.setenv("HYDRAGNN_SCREEN_TOPK", "99")
+    monkeypatch.setenv("HYDRAGNN_SCREEN_PREFETCH", "0")
+    cfg = screening_config_from({"Screening": {"topk": 7, "prefetch": 3}})
+    assert cfg.topk == 99 and cfg.prefetch == 0
+
+
+def test_score_head_must_be_graph_head():
+    samples = _fake_samples(8)
+    buckets = compute_pad_buckets(samples, 8, max_buckets=2)
+
+    class NodePredictor(FakePredictor):
+        cols = [("node", 0, 1)]
+
+    with pytest.raises(ValueError, match="graph head"):
+        BulkScreener(NodePredictor(), buckets, samples[0])
+    with pytest.raises(ValueError, match="score_col"):
+        BulkScreener(FakePredictor(), buckets, samples[0],
+                     cfg=ScreeningConfig(score_col=5))
+
+
+# -- real model: steady state, bit parity, SIGTERM e2e (slow-marked) ----------
+
+
+@pytest.fixture(scope="module")
+def screen_model():
+    """Tiny trained-shape GIN + augmented config, shared by the slow tests
+    (the module fixture never builds in a non-slow run)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.models.create import create_model_config
+    from hydragnn_tpu.preprocess.load_data import dataset_loading_and_splitting
+    from hydragnn_tpu.serve import Predictor
+    from hydragnn_tpu.train.optimizer import select_optimizer
+    from hydragnn_tpu.train.step import create_train_state
+
+    cfg = copy.deepcopy(CI_CONFIG)
+    samples = _fake_samples(60)
+    tl, vl, sl = dataset_loading_and_splitting(copy.deepcopy(cfg),
+                                               samples=samples)
+    aug = update_config(copy.deepcopy(cfg), tl.samples, vl.samples, sl.samples)
+    model = create_model_config(aug)
+    opt = select_optimizer(aug["NeuralNetwork"]["Training"]["Optimizer"])
+    state = create_train_state(
+        model, opt, jax.tree.map(jnp.asarray, next(iter(tl)))
+    )
+    return cfg, aug, model, state, samples, Predictor(model, state, aug)
+
+
+@pytest.mark.slow
+def test_screen_zero_recompile_steady_state(screen_model, compile_sentinel):
+    """The acceptance gate: after warm-up, screening the whole set performs
+    ZERO jit lowerings — on the double-buffered arm AND the naive arm."""
+    cfg, aug, model, state, samples, predictor = screen_model
+    buckets = compute_pad_buckets(samples, 8, max_buckets=3)
+    scr = BulkScreener(predictor, buckets, samples[0],
+                       cfg=ScreeningConfig(topk=10, batch_size=8, prefetch=2))
+    scr.warm(verify=True)
+    naive = BulkScreener(predictor, buckets, samples[0],
+                         cfg=ScreeningConfig(topk=10, batch_size=8,
+                                             prefetch=0))
+    naive.executables = scr.executables  # share the warm table, never warm
+    with compile_sentinel(max_compiles=0, what="steady-state screen"):
+        streamed = scr.screen(samples)
+        sync = naive.screen(samples, bulk=False)
+    assert streamed.completed and sync.completed
+    # both arms rank the bit-identical list (flag-only difference)
+    assert [(e.index, e.score) for e in streamed.topk] == [
+        (e.index, e.score) for e in sync.topk
+    ]
+
+
+@pytest.mark.slow
+def test_screen_bitmatch_run_prediction(screen_model):
+    """Screen the test split composed exactly as ``run_prediction``'s test
+    loader batches it; the scores must bit-match its graph-head predictions
+    (fp32/CPU — shared Predictor core, composition-identical batches)."""
+    from hydragnn_tpu.preprocess.load_data import dataset_loading_and_splitting
+    from hydragnn_tpu.run_prediction import run_prediction
+
+    cfg, aug, model, state, samples, predictor = screen_model
+    err, tasks_loss, trues, preds = run_prediction(
+        copy.deepcopy(cfg), state, model, samples=samples
+    )
+    _, _, test_loader = dataset_loading_and_splitting(
+        copy.deepcopy(cfg), samples=samples
+    )
+    chunks = [chunk for chunk, _pad in test_loader.batch_plan()]
+    covered = [int(i) for c in chunks for i in c]
+    scr = BulkScreener(
+        predictor, [test_loader.pad], samples[0],
+        cfg=ScreeningConfig(topk=len(covered),
+                            batch_size=test_loader.batch_size),
+    )
+    scr.warm(verify=True)
+    plan = plan_screen(test_loader.samples, covered, [test_loader.pad])
+    # single worst-case bucket: the planner's blocks ARE the loader's chunks
+    assert [b.indices.tolist() for b in plan.blocks] == [
+        [int(i) for i in c] for c in chunks
+    ]
+    res = scr.screen(test_loader.samples, indices=covered)
+    score_of = {e.index: e.score for e in res.topk}
+    expect = np.asarray(preds[0])[:, 0]
+    for row, idx in enumerate(covered):
+        assert np.float32(score_of[idx]) == np.float32(expect[row]), (
+            f"graph {idx}: screened {score_of[idx]!r} != "
+            f"run_prediction {expect[row]!r}"
+        )
+
+
+@pytest.mark.slow
+def test_screen_sigterm_resume_e2e(screen_model, tmp_path):
+    """The chaos-style drill with a REAL signal: SIGTERM mid-stream through
+    ``PreemptionHandler``, engine finalizes the sidecar and stops; clear,
+    resume, and the ranked top-k bit-matches an uninterrupted run."""
+    from hydragnn_tpu.resilience.preempt import PreemptionHandler
+
+    cfg, aug, model, state, samples, predictor = screen_model
+    buckets = compute_pad_buckets(samples, 8, max_buckets=3)
+    scfg = ScreeningConfig(topk=len(samples), batch_size=8, prefetch=2)
+    scr = BulkScreener(predictor, buckets, samples[0], cfg=scfg)
+    scr.warm(verify=True)
+    full = scr.screen(samples)
+
+    class KillAt:
+        """Delivers a real SIGTERM to this process at the n-th between-block
+        check; the handler's flag is what the engine then observes."""
+
+        def __init__(self, handler, at):
+            self.handler = handler
+            self.calls = 0
+            self.at = at
+
+        @property
+        def requested(self):
+            self.calls += 1
+            if self.calls == self.at:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return self.handler.requested
+
+    handler = PreemptionHandler().install()
+    mp = str(tmp_path / "screen_meta.json")
+    try:
+        r1 = scr.screen(samples, meta_path=mp,
+                        preempt=KillAt(handler, 2))
+        assert not r1.completed and handler.requested
+        handler.clear()
+        r2 = scr.screen(samples, meta_path=mp, resume=True)
+    finally:
+        handler.uninstall()
+    assert r2.completed and r2.resumed_from == r1.blocks_done
+    assert [tuple(e) for e in r2.topk] == [tuple(e) for e in full.topk]
+
+
+@pytest.mark.slow
+def test_screen_ensemble_variance_flags(screen_model):
+    """Population-ensemble confidence: scores stay single-model (bit-equal
+    to the plain screen) while member variance above the ceiling flags the
+    entry untrusted."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.train.population import stack_states
+
+    cfg, aug, model, state, samples, predictor = screen_model
+    # two members: the real state and a perturbed twin -> nonzero variance
+    bent = state._replace(
+        params=jax.tree.map(
+            lambda p: p * 1.5 if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            state.params,
+        )
+    )
+    pop = stack_states([state, bent])
+    buckets = compute_pad_buckets(samples, 8, max_buckets=2)
+    scfg = ScreeningConfig(topk=len(samples), batch_size=8,
+                           ensemble_variance_max=1e-12)
+    scr = BulkScreener(predictor, buckets, samples[0], cfg=scfg,
+                       pop_state=pop)
+    scr.warm(verify=True)
+    res = scr.screen(samples)
+    assert all(e.variance is not None for e in res.topk)
+    assert any(not e.trusted for e in res.topk)  # ceiling is tiny
+
+    plain = BulkScreener(
+        predictor, buckets, samples[0],
+        cfg=ScreeningConfig(topk=len(samples), batch_size=8),
+    )
+    plain.warm(verify=True)
+    base = plain.screen(samples)
+    assert [(e.index, e.score) for e in res.topk] == [
+        (e.index, e.score) for e in base.topk
+    ]

@@ -65,6 +65,11 @@ def _fresh_telemetry():
         tel.clear_context()
         tel.reset_metrics()
         tel.reset_trace()
+        # The aggregate Timer registry in utils.tracer is process-global and
+        # is NOT covered by reset_trace(); earlier train-loop tests leave
+        # their span counts behind, which breaks the absolute count
+        # assertions below under full-suite ordering.
+        tr.reset()
 
     _reset()
     yield
