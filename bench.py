@@ -198,6 +198,26 @@ def _flops_of(jitted, *args) -> float | None:
         return None
 
 
+def _ledger_snapshot(max_entries: int = 12) -> list:
+    """The cost observatory's view of the executables a row just warmed:
+    trimmed process-ledger entries (``telemetry/ledger.py``, fed by every
+    ``aot_compile`` site) attached as bench evidence — flops / bytes /
+    peak memory straight off the compiled artifacts, the CPU-provable
+    complement to wall-clock columns. Rows that want a row-scoped view
+    call ``ledger.reset_ledger()`` before their warm-up."""
+    try:
+        from hydragnn_tpu.telemetry import ledger as _ledger
+
+        keep = ("model", "bucket", "kind", "precision", "backend", "flops",
+                "bytes_accessed", "peak_bytes", "temp_bytes", "compile_s")
+        return [
+            {k: e[k] for k in keep if k in e}
+            for e in _ledger.entries()[:max_entries]
+        ]
+    except Exception:
+        return []
+
+
 def _time_steps(step_fn, state, batches, n_steps, key="loss"):
     """Run n_steps from pre-staged batches; returns (new_state, seconds)."""
     import jax
@@ -754,6 +774,9 @@ def bench_serving_ab(batch_size: int = 32, n_requests: int = 160,
                      batch_size=batch_size, flush_ms=0.0, max_batch_graphs=1)
     server.add_model("batched", model, state, cfg, samples=samples,
                      batch_size=batch_size, flush_ms=flush_ms)
+    from hydragnn_tpu.telemetry import ledger as cost_ledger
+
+    cost_ledger.reset_ledger()  # row-scoped cost-observatory snapshot
     c0 = compile_counts()["lowerings"]
     t0 = time.perf_counter()
     warm_report = server.warmup(verify=True)
@@ -798,6 +821,8 @@ def bench_serving_ab(batch_size: int = 32, n_requests: int = 160,
         "warmup_s": round(warmup_s, 3),
         "warmup_report": warm_report,
         "compiles_warmup": compiles_warmup,
+        # what those warm-up compiles COST (flops/bytes/peak per bucket)
+        "cost_ledger": _ledger_snapshot(),
         # steady-state lowering deltas per arm: the zero-recompile guarantee
         "compiles_steady_per_request": compiles["per_request"],
         "compiles_steady_batched": compiles["batched"],
@@ -883,6 +908,9 @@ def bench_screen_throughput_ab(batch_size: int = 32, n_graphs: int = 256,
         cfg=ScreeningConfig(topk=topk, batch_size=batch_size, prefetch=0,
                             bucket_major=False),
     )
+    from hydragnn_tpu.telemetry import ledger as cost_ledger
+
+    cost_ledger.reset_ledger()  # row-scoped cost-observatory snapshot
     c0 = compile_counts()["lowerings"]
     t0 = time.perf_counter()
     streamed.warm(verify=True)
@@ -943,6 +971,8 @@ def bench_screen_throughput_ab(batch_size: int = 32, n_graphs: int = 256,
         "topk": topk,
         "warmup_s": round(warmup_s, 3),
         "compiles_warmup": compiles_warmup,
+        # what those warm-up compiles COST (flops/bytes/peak per bucket)
+        "cost_ledger": _ledger_snapshot(),
         # steady-state lowering deltas per arm: the zero-recompile guarantee
         "compiles_steady_naive": compiles["naive"],
         "compiles_steady_streamed": compiles["streamed"],
@@ -1545,6 +1575,132 @@ def bench_telemetry_overhead_ab(batch_size: int = 64, epochs_per_window: int = 3
         "trace_events": n_trace,
         "batch_size": batch_size,
         "steps_per_window": epochs_per_window * len(loader),
+    }
+
+
+def bench_trace_propagation_ab(batch_size: int = 16, n_requests: int = 96,
+                               windows: int = 8) -> dict:
+    """Distributed-tracing A/B (ISSUE 18): identical fleet traffic — one
+    router over one loopback wire replica serving a REAL warm GIN
+    endpoint (same ingredients as the fleet rows, cache off so every
+    request walks the full admit -> dispatch -> RPC -> execute -> reply
+    path) — with trace-context propagation OFF vs ON. The ON arm pays
+    the full tentpole path per request: id mint + admit/dispatch/reply
+    journal records on the router, the JSON context field on the wire,
+    extraction + thread-scoped context + wire_serve/replica_execute
+    records on the replica side. The OFF arm must add ZERO wire bytes
+    and ZERO records. Budget <2% of a real fleet predict under the
+    shared ABBA paired-window noise-floor verdict — on the tiny CPU
+    canary the absolute price (~0.1-0.2 ms per traced request, mostly
+    the 5 journal records; the wire blob + scopes are ~25 us) is a
+    large-looking fraction of a ~3 ms toy predict and usually lands
+    inside the noise floor, so ``overhead_us_per_request`` is the
+    robust column. The enabled arm's per-request journal-record count
+    (router + replica dirs combined) rides along as evidence it did
+    the work being priced."""
+    import tempfile
+
+    from hydragnn_tpu import telemetry
+    from hydragnn_tpu.serve import (
+        FleetRouter,
+        PredictionServer,
+        ReplicaHost,
+        ServingConfig,
+    )
+    from hydragnn_tpu.telemetry.journal import EventJournal
+
+    cfg, model, state, samples = _fleet_model_ingredients(batch_size, seed=53)
+    srv = PredictionServer(ServingConfig(
+        flush_ms=3.0, queue_depth=max(512, n_requests)
+    ))
+    t0 = time.perf_counter()
+    srv.add_model("m", model, state, cfg, samples=samples,
+                  batch_size=batch_size)
+    srv.warmup(verify=True)
+    srv.start()
+    warmup_s = time.perf_counter() - t0
+    tmp = tempfile.mkdtemp(prefix="bench-trace-prop-")
+    router_events = os.path.join(tmp, "router", "events.jsonl")
+    replica_events = os.path.join(tmp, "replica0", "events.jsonl")
+    telemetry.open_journal(file=router_events, run_id="router")
+    rep_journal = EventJournal(replica_events, run_id="replica0")
+    host = ReplicaHost(srv, journal=rep_journal)
+    # cache off: every request walks the full admit -> dispatch -> RPC ->
+    # reply path (a cache hit would skip the very wire the row prices)
+    router = FleetRouter({"peer_timeout": 30.0, "cache_bytes": 0})
+
+    def window() -> float:
+        t0 = time.perf_counter()
+        futs = [
+            router.submit("m", samples[i % len(samples)])
+            for i in range(n_requests)
+        ]
+        for fut in futs:
+            fut.result(timeout=120)
+        return time.perf_counter() - t0
+
+    on_requests = 0
+    try:
+        router.attach("127.0.0.1", host.port)
+        router.start()
+        # settle both arms untimed (socket pool + allocator warm)
+        telemetry.set_propagate_enabled(False)
+        window()
+        telemetry.set_propagate_enabled(True)
+        window()
+        on_requests += n_requests
+        off_ms, on_ms = [], []
+        for w in range(max(windows, 1)):
+            if w % 2 == 0:
+                telemetry.set_propagate_enabled(False)
+                t_off = window()
+                telemetry.set_propagate_enabled(True)
+                t_on = window()
+            else:
+                telemetry.set_propagate_enabled(True)
+                t_on = window()
+                telemetry.set_propagate_enabled(False)
+                t_off = window()
+            on_requests += n_requests
+            off_ms.append(1e3 * t_off / n_requests)
+            on_ms.append(1e3 * t_on / n_requests)
+    finally:
+        router.stop()
+        host.close()
+        srv.stop()
+        rep_journal.close()
+        telemetry.close_journal()
+        telemetry.set_propagate_enabled(None)
+    router_recs = telemetry.read_journal(router_events)
+    replica_recs = telemetry.read_journal(replica_events)
+    n_records = len(router_recs) + len(replica_recs)
+    overhead_pct, noise_pct, verdict = _abba_verdict(off_ms, on_ms,
+                                                     budget_pct=2.0)
+    return {
+        "workload": "trace_propagation",
+        "batch_size": batch_size,
+        "warmup_s": round(warmup_s, 3),
+        "req_ms_disabled": round(statistics.median(off_ms), 4),
+        "req_ms_enabled": round(statistics.median(on_ms), 4),
+        "req_ms_disabled_windows": [round(x, 3) for x in off_ms],
+        "req_ms_enabled_windows": [round(x, 3) for x in on_ms],
+        "propagation_overhead_pct": round(overhead_pct, 2),
+        # the absolute price per traced request — the robust claim when the
+        # toy predict's short wall time makes the percentage noise-bound
+        "overhead_us_per_request": round(
+            1e3 * (statistics.median(on_ms) - statistics.median(off_ms)), 1
+        ),
+        "noise_pct": round(noise_pct, 2),
+        "budget_pct": 2.0,
+        "verdict": verdict,
+        "within_budget": verdict != "fail",
+        # proof the enabled arm did the work being priced — and that the
+        # disabled arm journaled NOTHING (every record belongs to a traced
+        # request, so this ratio is per ENABLED request)
+        "journal_records_router": len(router_recs),
+        "journal_records_replica": len(replica_recs),
+        "records_per_traced_request": round(n_records / max(on_requests, 1), 2),
+        "requests_per_window": n_requests,
     }
 
 
@@ -2351,6 +2507,11 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
     # ISSUE 17 row: bulk-screening throughput A/B is CPU-provable by
     # construction (flag-identity arms + bit-identity + lowering counts)
     screen_throughput = _row(bench_screen_throughput_ab, min(batch_size, 32), 128)
+    # ISSUE 18 row: trace-propagation overhead is pure host + loopback-wire
+    # bookkeeping priced against a real warm replica predict — CPU-provable
+    # by construction
+    trace_propagation = _row(bench_trace_propagation_ab,
+                             min(batch_size, 16), 48, 4)
     return {
         "workload": "cpu_smoke",
         "degraded": True,
@@ -2372,6 +2533,7 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
         "elastic_remesh_ab": elastic_remesh,
         "telemetry_overhead_ab": telemetry_overhead,
         "screen_throughput_ab": screen_throughput,
+        "trace_propagation_ab": trace_propagation,
     }
 
 
@@ -3195,6 +3357,12 @@ def child_main(status_path: str) -> None:
     # graphs/sec headline) — CPU-provable by construction
     plan.append(("screen_throughput_ab",
                  lambda: bench_screen_throughput_ab(min(batch_size, 32))))
+    # ISSUE 18 acceptance row: wire-level trace propagation priced
+    # enabled-vs-disabled over a real loopback fleet round trip (<2% budget,
+    # cross-process journal record counts as did-the-work evidence) —
+    # CPU-provable by construction
+    plan.append(("trace_propagation_ab",
+                 lambda: bench_trace_propagation_ab()))
     if os.getenv("BENCH_FUSED_AUTOTUNE", "1") != "0":
         # cheap kernel-only sweep BEFORE the compile-heavy arch entries, so
         # a short window still yields the tuning data it was added for

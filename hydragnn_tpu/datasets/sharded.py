@@ -631,15 +631,35 @@ class ShardedStore:
         gets its socket severed from the monitor thread, which surfaces
         here as an OSError and takes the normal quarantine+failover path.
 
+        When trace propagation is armed, the walk runs under one
+        ``request_id`` (adopted from the ambient context or minted here),
+        every hop emits a ``store_hop`` child record naming the peer it
+        tried (``outcome=quarantined`` for the transport-failed peer,
+        ``outcome=served`` for the winner), and the peer sees the same id
+        in its own journal — one fetch, one cross-process timeline.
+
         Returns ``(decoded frame, rank, s0, s1)`` of the replica that
         answered. ``fields_for(s0, s1)`` builds the request for an owner
         advertising ``[s0, s1)`` — replicas of one range may be advertised
         with different spans, and local indices are span-relative."""
+        from .. import telemetry as tel
+
+        traced = tel.propagate_enabled()
+        if not traced:
+            return self._failover_walk(owner_ranks, fields_for, what, False)
+        rid = tel.get_context().get("request_id") or tel.new_request_id()
+        with tel.scoped_context(request_id=rid):
+            return self._failover_walk(owner_ranks, fields_for, what, True)
+
+    def _failover_walk(self, owner_ranks, fields_for, what: str,
+                       traced: bool):
+        from .. import telemetry as tel
         from ..utils.retry import store_policy
 
         policy = store_policy()
         last_err: BaseException | None = None
         failed_over = False
+        hop = 0
         for rnd in range(policy.attempts):
             if rnd:
                 sleep_s = policy.delay(rnd)
@@ -654,6 +674,7 @@ class ShardedStore:
             for rank in order:
                 host, port, s0, s1 = self.peers[rank]
                 cell: dict = {"sock": None}
+                t0_wall = time.time()
                 try:
                     with self._guard_round_trip(host, port, cell):
                         z = _unpack_arrays(self._request(
@@ -663,16 +684,40 @@ class ShardedStore:
                 except (ConnectionError, OSError) as e:
                     last_err = e
                     failed_over = True
+                    if traced:
+                        tel.emit(
+                            "store_hop", hop=hop, peer=rank, host=host,
+                            port=port, outcome="quarantined",
+                            error=type(e).__name__,
+                        )
+                        if tel.trace_enabled():
+                            tel.add_span(
+                                f"store_hop:{rank}", t0_wall,
+                                time.time() - t0_wall,
+                                args={"peer": rank, "outcome": "quarantined"},
+                            )
+                    hop += 1
                     self._mark_peer_down(rank, e, failover=len(order) > 1)
                     continue
                 self._check_status(z, host, port, s0, s1)
                 self._mark_peer_up(rank)
+                if traced:
+                    tel.emit(
+                        "store_hop", hop=hop, peer=rank, host=host,
+                        port=port, outcome="served",
+                        failed_over=bool(failed_over),
+                        dur_s=round(time.time() - t0_wall, 6),
+                    )
+                    if tel.trace_enabled():
+                        tel.add_span(
+                            f"store_hop:{rank}", t0_wall,
+                            time.time() - t0_wall,
+                            args={"peer": rank, "outcome": "served"},
+                        )
                 if failed_over:
                     n = int(z.get("n", np.asarray(0)))
                     with self._lock:
                         self.failover_fetches += max(n, 0)
-                    from .. import telemetry as tel
-
                     tel.counter("store_failover_fetches_total").inc(max(n, 0))
                 return z, rank, s0, s1
         raise ConnectionError(

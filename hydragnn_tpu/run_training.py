@@ -94,6 +94,18 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
                 )
             except OSError as e:
                 print_distributed(verbosity, f"trace.json save failed: {e}")
+        if rank == 0:
+            # cost observatory: persist whatever the run's AOT sites (and
+            # the opt-in train-step probe) recorded, next to this run's
+            # journal — a path-valued HYDRAGNN_LEDGER redirects it. Empty
+            # ledgers (plain training without the probe armed) write
+            # nothing.
+            try:
+                telemetry.ledger.maybe_save(
+                    os.path.join("./logs", log_name, "ledger.json")
+                )
+            except OSError as e:
+                print_distributed(verbosity, f"ledger.json save failed: {e}")
         telemetry.close_journal()
 
     # try/finally so a CRASHED run — the post-mortem CLI's whole

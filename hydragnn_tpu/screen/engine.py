@@ -130,6 +130,9 @@ class BulkScreener:
             )
         report = {}
         dummy = _dummy_sample(self.example)
+        # ledger label: the screener serves one model; its architecture
+        # name is the most stable identity available
+        model_label = getattr(self.predictor.spec, "mpnn_type", None) or "screen"
         for pad in self.buckets:
             batch = serving_collate([dummy], pad)
             t0 = time.perf_counter()
@@ -137,10 +140,20 @@ class BulkScreener:
                 self.predictor.predict_step,
                 self.predictor.state,
                 shape_structs(batch),
+                ledger_entry={
+                    "model": model_label, "bucket": pad.as_tuple(),
+                    "kind": "screen_predict",
+                    "precision": str(self.predictor.compute_dtype),
+                },
             )
             if self._ens_step is not None:
                 self.executables_ens[pad.as_tuple()] = aot_compile(
-                    self._ens_step, self.pop_state.state, shape_structs(batch)
+                    self._ens_step, self.pop_state.state, shape_structs(batch),
+                    ledger_entry={
+                        "model": model_label, "bucket": pad.as_tuple(),
+                        "kind": "screen_ensemble",
+                        "precision": str(self.predictor.compute_dtype),
+                    },
                 )
             report[repr(pad)] = round(time.perf_counter() - t0, 4)
         if verify:
@@ -151,6 +164,10 @@ class BulkScreener:
                     exe = self.executables_ens.get(pad.as_tuple())
                     if exe is not None:
                         exe(self.pop_state.state, b)
+        # a path-valued HYDRAGNN_LEDGER persists the cost entries the loop
+        # above recorded — screen runs leave the same ledger.json evidence
+        # serve warm-ups do
+        tel.ledger.maybe_save()
         return report
 
     # -- sidecar (exact-resume position record) ------------------------------

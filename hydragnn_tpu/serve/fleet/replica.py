@@ -57,7 +57,8 @@ class ReplicaHost(wire.WireServer):
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
                  auth_token: str | None = None,
-                 predict_timeout_s: float = _PREDICT_TIMEOUT_S):
+                 predict_timeout_s: float = _PREDICT_TIMEOUT_S,
+                 journal=None):
         from ...analysis.sentinel import compile_counts
 
         self.server = server
@@ -66,8 +67,12 @@ class ReplicaHost(wire.WireServer):
         # which a warmed replica must keep at zero (the strict-sentinel
         # property, observable over the wire)
         self._ready_lowerings = int(compile_counts()["lowerings"])
+        # journal= routes this replica's trace-scoped records into its own
+        # EventJournal (subprocess workers: their log dir; in-process
+        # tests: a distinct dir per replica) instead of the process-global
+        # journal the router writes
         super().__init__(host=host, port=port, auth_token=auth_token,
-                         name="ReplicaHost")
+                         name="ReplicaHost", journal=journal)
 
     # -- wire ops -----------------------------------------------------------
 
@@ -103,8 +108,15 @@ class ReplicaHost(wire.WireServer):
         raise ValueError(f"unknown fleet op in frame keys {sorted(z)}")
 
     def _handle_predict(self, z: dict) -> dict:
+        from ... import telemetry as tel
+
         model = wire.field_text(z.get("model"))
         sample = wire.samples_from_frame(z)[0]
+        # the handler thread's scope (set by WireServer from the frame's
+        # trace context) decides whether this predict is part of a traced
+        # request — only then does it journal, so untraced traffic adds
+        # zero records
+        traced = bool(tel.get_context().get("request_id"))
         try:
             fut = self.server.submit(model, sample)
             result = fut.result(timeout=self._predict_timeout_s)
@@ -112,11 +124,20 @@ class ReplicaHost(wire.WireServer):
             # typed shed: the router re-raises the same admission class on
             # its side of the wire (never laundered into a transport fault
             # — a shed is an ANSWER, failover would re-ask the question)
+            if traced:
+                self.emit_event(
+                    "replica_execute", model=model, shed=type(e).__name__,
+                )
             return {
                 "n": np.asarray(-4, np.int64),
                 "etype": wire.text_field(type(e).__name__),
                 "detail": wire.text_field(str(e)[:512]),
             }
+        if traced:
+            self.emit_event(
+                "replica_execute", model=model,
+                latency_s=round(float(result["latency_s"]), 6),
+            )
         out = {
             "n": np.asarray(1, np.int64),
             "nheads": np.asarray(len(result["heads"]), np.int64),
@@ -197,14 +218,29 @@ def worker_main(argv=None) -> int:
         os.replace(tmp, ready)  # atomic: the parent never reads a torn file
 
     try:
+        from ... import telemetry as tel
+
+        # the worker's own observability surfaces, rooted in its log dir
+        # (default: next to the spec): the journal the fleet CLI merges
+        # with the router's, and the cost ledger of its warmed executables
+        log_dir = spec.get("log_dir") or os.path.dirname(
+            os.path.abspath(spec.get("ready_file", argv[0])))
+        journal = None
+        if tel.enabled():
+            journal = tel.open_journal(
+                file=os.path.join(log_dir, "events.jsonl"),
+                run_id=f"replica-{os.getpid()}",
+            )
         server = _build_server(spec)
         server.warmup(verify=True)  # ready MEANS warm: zero first-request compiles
+        tel.ledger.maybe_save(os.path.join(log_dir, "ledger.json"))
         server.start()
         host = ReplicaHost(
             server,
             host=spec.get("bind_host", "127.0.0.1"),
             port=int(spec.get("port", 0)),
             auth_token=spec.get("auth"),
+            journal=journal,
         )
     except Exception:
         import traceback
@@ -224,6 +260,9 @@ def worker_main(argv=None) -> int:
         time.sleep(0.1)
     host.close()
     server.stop()
+    from ... import telemetry as tel
+
+    tel.close_journal()
     return 0
 
 

@@ -70,6 +70,11 @@ class RoutedRequest(Request):
     priority: str = "interactive"
     digest: str | None = None  # answer-cache key (None = cache disabled)
     attempts: int = 0          # replica round-trips consumed (failover cap)
+    # fleet-unique trace id minted at admission (None = propagation off);
+    # scoped around every downstream stage so the journal records of
+    # admission -> dispatch -> replica execute -> reply -> cache fill share
+    # it across processes
+    request_id: str | None = None
 
 
 @dataclasses.dataclass
@@ -268,6 +273,17 @@ class FleetRouter:
         req = RoutedRequest(
             sample=sample, deadline=deadline, model=model, priority=priority
         )
+        if tel.propagate_enabled():
+            # adopt the caller's ambient request_id (an upstream tier may
+            # have minted one) or mint the fleet-unique id every stage of
+            # this request's timeline will share
+            req.request_id = (
+                tel.get_context().get("request_id") or tel.new_request_id()
+            )
+            tel.emit(
+                "fleet_admit", request_id=req.request_id, model=model,
+                **{"class": priority},
+            )
         if self.cfg.cache_bytes > 0:
             quant = any(
                 r.quantized.get(model, False) for r in self._replicas
@@ -277,6 +293,11 @@ class FleetRouter:
             if hit is not None:
                 self._count("cache_hits")
                 self._count("served")
+                if req.request_id is not None:
+                    tel.emit(
+                        "fleet_cache_hit", request_id=req.request_id,
+                        model=model,
+                    )
                 if req.claim():
                     req.future.set_result({
                         "heads": hit,
@@ -451,12 +472,24 @@ class FleetRouter:
     # -- replica round-trip -------------------------------------------------
 
     def _serve_one(self, req: RoutedRequest, replica: _Replica) -> None:
+        # the request's trace id becomes this dispatcher THREAD's journal
+        # scope: every record below carries it, and RoundTripper.request
+        # ships it to the replica inside the frame (propagation armed)
+        with tel.scoped_context(request_id=req.request_id):
+            self._serve_one_scoped(req, replica)
+
+    def _serve_one_scoped(self, req: RoutedRequest, replica: _Replica) -> None:
         try:
             fields = {
                 "predict": np.asarray(1, np.int64),
                 "model": wire.text_field(req.model),
                 **wire.sample_fields([req.sample]),
             }
+            if req.request_id is not None:
+                tel.emit(
+                    "fleet_dispatch", model=req.model, replica=replica.rank,
+                    attempt=req.attempts,
+                )
             try:
                 z = self._rt.round_trip(
                     (replica.host, replica.port), replica.host, replica.port,
@@ -549,12 +582,20 @@ class FleetRouter:
             # the same graph the instant its result lands must find the
             # cache populated, not race the insert
             self.cache.put(req.digest, heads)
+            if req.request_id is not None:
+                tel.emit("fleet_cache_fill", model=req.model)
         if not req.claim():
             self._count("cancelled")
             return
+        latency_s = time.monotonic() - req.enqueued_at
+        if req.request_id is not None:
+            tel.emit(
+                "fleet_reply", model=req.model, replica=replica.rank,
+                latency_s=round(latency_s, 6),
+            )
         req.future.set_result({
             "heads": heads,
-            "latency_s": time.monotonic() - req.enqueued_at,
+            "latency_s": latency_s,
             "replica": replica.rank,
             "cached": False,
         })

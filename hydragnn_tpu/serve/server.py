@@ -316,6 +316,11 @@ class ModelEndpoint:
                 self.predictor.predict_step,
                 self.predictor.state,
                 shape_structs(batch),
+                ledger_entry={
+                    "model": self.name, "bucket": pad.as_tuple(),
+                    "kind": "predict",
+                    "precision": str(self.predictor.compute_dtype),
+                },
             )
             report[repr(pad)] = round(time.perf_counter() - t0, 4)
         if self.cfg.quantize:
@@ -382,7 +387,13 @@ class ModelEndpoint:
                 pred.model, scales, weights, pred.compute_dtype
             )
             t0 = time.perf_counter()
-            exe = aot_compile(q_step, pred.state, shape_structs(batches[0]))
+            exe = aot_compile(
+                q_step, pred.state, shape_structs(batches[0]),
+                ledger_entry={
+                    "model": self.name, "bucket": pad.as_tuple(),
+                    "kind": "quant_predict", "precision": "int8",
+                },
+            )
             pad_bounds = certify_quant_error(pred, exe, batches)
             bounds = [max(a, b) for a, b in zip(bounds, pad_bounds)]
             self.executables_quant[pad.as_tuple()] = exe
@@ -626,6 +637,10 @@ class PredictionServer:
             "serve_warmup", models=sorted(self._models),
             total_s=report["total_s"],
         )
+        # every (model, bucket) executable above fed the cost ledger; a
+        # path-valued HYDRAGNN_LEDGER persists the document here so serve
+        # warm-ups leave the same ledger.json evidence trains/screens do
+        tel.ledger.maybe_save()
         return report
 
     # -- lifecycle ----------------------------------------------------------

@@ -27,6 +27,9 @@ records the span timeline. :func:`configure` applies a validated
 
 from __future__ import annotations
 
+import contextlib
+
+from . import ledger, propagation
 from .config import TelemetryConfig, telemetry_config_defaults
 from .journal import (
     EventJournal,
@@ -37,8 +40,10 @@ from .journal import (
     get_context,
     open_journal,
     read_journal,
+    scoped_context,
     set_context,
 )
+from .ledger import CostLedger
 from .metrics import (
     Counter,
     Gauge,
@@ -55,6 +60,7 @@ from .metrics import (
     set_enabled,
     snapshot,
 )
+from .propagation import new_request_id, propagate_enabled, set_propagate_enabled
 from .trace import (
     add_span,
     reset_trace,
@@ -67,20 +73,48 @@ from .trace import (
 
 def configure(cfg: "TelemetryConfig | dict | None") -> "TelemetryConfig | None":
     """Apply a ``Telemetry`` config block process-wide (``None`` resets
-    both overrides to follow the env flags). Returns the applied config."""
+    every override to follow the env flags). Returns the applied config."""
     if cfg is None:
         set_enabled(None)
         set_trace_enabled(None)
+        set_propagate_enabled(None)
         return None
     if not isinstance(cfg, TelemetryConfig):
         cfg = TelemetryConfig.from_config(cfg)
     cfg.validate()
     set_enabled(cfg.enabled)
     set_trace_enabled(cfg.trace_events)
+    set_propagate_enabled(cfg.trace_propagate)
     return cfg
 
 
+@contextlib.contextmanager
+def isolate():
+    """Scoped FRESH-INSTANCE isolation of every process-global telemetry
+    surface: metrics registry, trace buffer, tracer timers, cost ledger,
+    active journal + correlation context, and the config overrides. The
+    previous state is fully restored on exit — the ``telemetry_isolate``
+    pytest fixture wraps this, so absolute-count assertions hold under
+    any suite ordering without reset band-aids."""
+    from ..utils import tracer as _tracer
+    from . import journal as _journal, metrics as _metrics, trace as _trace
+
+    prev_enabled = _metrics._ENABLED_OVERRIDE
+    prev_trace = _trace._TRACE_OVERRIDE
+    prev_prop = propagation._PROPAGATE_OVERRIDE
+    with _metrics.isolated_registry(), _trace.isolated_buffer(), \
+            _tracer.isolated_timers(), ledger.isolated_ledger(), \
+            _journal.isolated():
+        try:
+            yield
+        finally:
+            _metrics.set_enabled(prev_enabled)
+            _trace.set_trace_enabled(prev_trace)
+            propagation.set_propagate_enabled(prev_prop)
+
+
 __all__ = [
+    "CostLedger",
     "Counter",
     "EventJournal",
     "Gauge",
@@ -100,14 +134,21 @@ __all__ = [
     "gauge",
     "get_context",
     "histogram",
+    "isolate",
+    "ledger",
+    "new_request_id",
     "open_journal",
+    "propagate_enabled",
+    "propagation",
     "publish",
     "read_journal",
     "reset_metrics",
     "reset_trace",
     "save_trace",
+    "scoped_context",
     "set_context",
     "set_enabled",
+    "set_propagate_enabled",
     "set_trace_enabled",
     "snapshot",
     "telemetry_config_defaults",

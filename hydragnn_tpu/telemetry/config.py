@@ -26,6 +26,8 @@ class TelemetryConfig:
     enabled: bool = True        # the whole plane: registry + journal + traces
     journal: bool = True        # write logs/<run>/events.jsonl during runs
     trace_events: bool = False  # record Chrome trace events (trace.json)
+    trace_propagate: bool = True  # ship correlation ids across the wire
+    ledger: bool = True         # capture compiled-program cost entries
 
     @staticmethod
     def from_config(config: dict | None) -> "TelemetryConfig":
@@ -62,10 +64,18 @@ class TelemetryConfig:
             self.enabled = bool(flags.get(flags.TELEMETRY))
         if os.getenv(flags.TRACE_EVENTS.name):
             self.trace_events = bool(flags.get(flags.TRACE_EVENTS))
+        if os.getenv(flags.TRACE_PROPAGATE.name):
+            self.trace_propagate = bool(flags.get(flags.TRACE_PROPAGATE))
+        if os.getenv(flags.LEDGER.name):
+            # HYDRAGNN_LEDGER is a str flag ('0' disables, a path also
+            # arms saving); here only the on/off half applies
+            self.ledger = str(flags.get(flags.LEDGER)) not in (
+                "0", "false", "no", "off")
         return self
 
     def validate(self) -> "TelemetryConfig":
-        for key in ("enabled", "journal", "trace_events"):
+        for key in ("enabled", "journal", "trace_events", "trace_propagate",
+                    "ledger"):
             value = getattr(self, key)
             if not isinstance(value, bool):
                 raise ValueError(

@@ -29,6 +29,7 @@ leaves into gauges without touching the dict.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 
 from ..utils import flags
@@ -288,6 +289,26 @@ def reset_metrics() -> None:
     REGISTRY.reset()
 
 
+@contextlib.contextmanager
+def isolated_registry():
+    """Swap the process-global ``REGISTRY`` for a FRESH instance for the
+    duration of the scope, restoring the previous one on exit. Because the
+    module-level ``counter()``/``gauge()``/``histogram()``/``snapshot()``
+    helpers read the global at call time, everything inside the scope —
+    including code in other threads started inside it — lands in the fresh
+    registry, so absolute-count assertions are safe under any suite
+    ordering (no reset band-aids needed). The swap is a single attribute
+    rebind (atomic under the GIL); concurrent readers see either registry,
+    never a torn state."""
+    global REGISTRY
+    fresh = MetricsRegistry()
+    prev, REGISTRY = REGISTRY, fresh
+    try:
+        yield fresh
+    finally:
+        REGISTRY = prev
+
+
 def publish(prefix: str, stats: dict, **labels) -> None:
     """Mirror a ``stats()`` dict's numeric leaves into gauges
     (``{prefix}_{key}``) without touching the dict — the bridge that lets
@@ -315,6 +336,7 @@ __all__ = [
     "gauge",
     "histogram",
     "enabled",
+    "isolated_registry",
     "publish",
     "reset_metrics",
     "set_enabled",

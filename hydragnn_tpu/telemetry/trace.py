@@ -19,6 +19,7 @@ counter the save reports instead of silently truncating.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -138,11 +139,27 @@ def reset_trace() -> None:
     BUFFER.reset()
 
 
+@contextlib.contextmanager
+def isolated_buffer():
+    """Swap the process-global span ``BUFFER`` for a fresh instance for
+    the duration of the scope (same single-rebind pattern as
+    ``metrics.isolated_registry``) — trace-event count assertions become
+    safe under any suite ordering."""
+    global BUFFER
+    fresh = TraceBuffer()
+    prev, BUFFER = BUFFER, fresh
+    try:
+        yield fresh
+    finally:
+        BUFFER = prev
+
+
 __all__ = [
     "BUFFER",
     "MAX_EVENTS",
     "TraceBuffer",
     "add_span",
+    "isolated_buffer",
     "reset_trace",
     "save_trace",
     "set_trace_enabled",

@@ -45,6 +45,49 @@ def _max_num_batches(loader) -> int:
     return n
 
 
+_LEDGER_PROBED = False  # guarded-by: GIL (one-shot latch, single flip)
+
+
+def _maybe_ledger_probe(train_step, state, batch):
+    """One-shot cost-ledger capture of the train-step program.
+
+    Explicit opt-in: only runs when ``HYDRAGNN_LEDGER`` is armed with a save
+    destination — the probe pays one extra lower+compile of the step
+    signature on the jit path (the persistent compile cache makes the
+    backend compile a disk hit, but the trace/lower is real work and bumps
+    the recompile sentinel's lowering count), so the default path must stay
+    untouched. Lowers against abstract twins of both state and batch so the
+    probe never touches donated buffers. A probe failure never touches
+    training."""
+    global _LEDGER_PROBED
+    if _LEDGER_PROBED:
+        return
+    _LEDGER_PROBED = True
+    try:
+        from ..telemetry import ledger as _ledger
+
+        if _ledger.save_path() is None or not _ledger.capture_enabled():
+            return
+        if not hasattr(train_step, "lower"):
+            return  # non-jit dispatch (shouldn't happen; stay silent)
+        from ..utils.compile_cache import aot_compile, shape_structs
+
+        leaves = jax.tree.leaves(batch)
+        bucket = (len(leaves), int(sum(int(np.size(x)) for x in leaves)))
+        params = jax.tree.leaves(getattr(state, "params", None))
+        precision = str(params[0].dtype) if params else None
+        model = str(tel.get_context().get("run_id") or "train")
+        aot_compile(
+            train_step, shape_structs(state), shape_structs(batch),
+            ledger_entry={
+                "model": model, "bucket": bucket, "kind": "train_step",
+                "precision": precision,
+            },
+        )
+    except Exception:
+        pass
+
+
 def _empty_like(batch):
     """Same bucket, zero masks/targets: contributes nothing to any
     graph-count-weighted metric (used to fill partial device groups)."""
@@ -340,6 +383,10 @@ def train_epoch(
                 elif mesh is None and k == 1:
                     batch = jax.tree.map(jnp.asarray, batch)
                 state, metrics = train_step(state, batch)
+                if ib == 0:
+                    # cost observatory: one-shot train-step ledger capture
+                    # (no-op unless HYDRAGNN_LEDGER names a save path)
+                    _maybe_ledger_probe(train_step, state, batch)
                 step_metrics.append(metrics)
                 dispatches += 1
                 with wd("train step sync (backpressure)"):

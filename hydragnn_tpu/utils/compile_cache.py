@@ -53,7 +53,7 @@ def shape_structs(tree):
     )
 
 
-def aot_compile(jitted, *args):
+def aot_compile(jitted, *args, ledger_entry: dict | None = None):
     """Ahead-of-time lower + compile one signature of a jitted callable and
     return the executable: ``aot_compile(fn, state, shape_structs(batch))``.
 
@@ -66,5 +66,24 @@ def aot_compile(jitted, *args):
 
     Args may mix concrete arrays (live params) and ``ShapeDtypeStruct``
     signatures (the per-bucket batch shape).
+
+    ``ledger_entry`` labels the executable's cost-ledger record
+    (``{"model": ..., "bucket": ..., "kind": ..., "precision": ...}``) —
+    every AOT site feeds the cost observatory
+    (``telemetry/ledger.py``); reading ``cost_analysis()`` off an
+    already-built executable is free, and capture is a no-op when the
+    telemetry plane (or ``HYDRAGNN_LEDGER``) is off. A telemetry failure
+    never fails the compile.
     """
-    return jitted.lower(*args).compile()
+    import time
+
+    t0 = time.perf_counter()
+    compiled = jitted.lower(*args).compile()
+    elapsed = time.perf_counter() - t0
+    try:
+        from ..telemetry import ledger as _ledger
+
+        _ledger.record(compiled, compile_s=elapsed, **(ledger_entry or {}))
+    except Exception:
+        pass
+    return compiled

@@ -320,6 +320,26 @@ TRACE_EVENTS = _register(Flag(
     "aggregate span timers (utils/tracer.py) always run; this arms the "
     "per-span TIMELINE view. Overrides Telemetry.trace_events; requires "
     "HYDRAGNN_TELEMETRY on."))
+TRACE_PROPAGATE = _register(Flag(
+    "HYDRAGNN_TRACE_PROPAGATE", "bool", True,
+    "Propagate the ambient trace context (request_id / parent span / "
+    "journal correlation ids) across the wire: RoundTripper.request "
+    "stamps one optional frame field, WireServer extracts it into the "
+    "handler's journal scope, so a fleet predict or a sharded-store "
+    "failover renders as ONE cross-process timeline (telemetry fleet "
+    "CLI). =0 removes the field entirely — zero wire bytes, near-zero "
+    "cost (the trace_propagation_ab bench row holds the enabled path "
+    "under a <2% budget). Overrides Telemetry.trace_propagate; requires "
+    "HYDRAGNN_TELEMETRY on."))
+LEDGER = _register(Flag(
+    "HYDRAGNN_LEDGER", "str", None,
+    "Compiled-program cost ledger (telemetry/ledger.py). Unset: every "
+    "aot_compile records cost_analysis()/memory_analysis() in memory "
+    "(free — the executable already exists); runs that open a journal "
+    "persist logs/<run>/ledger.json. '0'/'false': disable capture. A "
+    "path: ALSO save the cumulative ledger there after serve warm-up / "
+    "screen warm-up (the bench + CI regression-sentinel hook; diff two "
+    "ledgers with `python -m hydragnn_tpu.telemetry ledger`)."))
 USE_VARIABLE_GRAPH_SIZE = _register(Flag(
     "HYDRAGNN_USE_VARIABLE_GRAPH_SIZE", "bool", None,
     "Force the variable-graph-size config path (reference "

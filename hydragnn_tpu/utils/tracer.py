@@ -123,6 +123,23 @@ def reset():
     _timers.clear()
 
 
+@contextlib.contextmanager
+def isolated_timers():
+    """Swap the process-global aggregate ``Timer`` registry for a fresh
+    one for the duration of the scope (single rebind, atomic under the
+    GIL) — the tracer half of ``telemetry.isolate()``. Spans started
+    inside the scope land in the fresh registry because every accessor
+    reads the module global at call time; the previous registry — and any
+    half-open spans it held — comes back intact on exit."""
+    global _timers
+    fresh: dict[str, Timer] = defaultdict(Timer)
+    prev, _timers = _timers, fresh
+    try:
+        yield fresh
+    finally:
+        _timers = prev
+
+
 def get(name: str) -> Timer:
     return _timers[name]
 

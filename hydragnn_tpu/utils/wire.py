@@ -51,9 +51,21 @@ import time
 import numpy as np
 
 from ..graphs.graph import GraphSample
+from ..telemetry import journal as _journal, propagation as _propagation
 
 HDR = struct.Struct("<q")  # payload byte length
 MAGIC = b"GSX1"
+
+# known op keys, most specific first — the label a served frame's journal
+# record carries (fall through to "frame" for ops this module hasn't met)
+_OP_KEYS = ("predict", "stats", "metrics", "sizes", "idx")
+
+
+def frame_op(z: dict) -> str:
+    for key in _OP_KEYS:
+        if key in z:
+            return key
+    return "frame"
 
 
 # -- framing + array codec ----------------------------------------------------
@@ -334,10 +346,22 @@ class WireServer:
     sockets error on reuse instead of being silently served by a 'dead'
     peer. ``port=0`` picks an ephemeral port; a fixed port lets a
     restarted host come back at the address its peers already advertise,
-    so a prober's quarantine-lift finds it."""
+    so a prober's quarantine-lift finds it.
+
+    Trace propagation: a frame carrying the optional trace-context field
+    (``telemetry.propagation``) has its correlation ids entered into the
+    handler THREAD's journal scope around ``handle_frame`` — every record
+    and span the handler emits shares the client's ``request_id`` — and
+    the serve itself emits one ``wire_serve`` record. Legacy frames (no
+    field) take the exact pre-existing path. ``journal=`` routes this
+    server's records to a private ``EventJournal`` instead of the
+    process-global one, so a subprocess replica journals into its own log
+    dir (and in-process tests can give router and replica DISTINCT
+    journals)."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  auth_token: str | None = None, name: str | None = None,
+                 journal: "_journal.EventJournal | None" = None,
                  _test_delay_s: float = 0.0):
         outer = self
         tok = None if auth_token is None else auth_token.encode()
@@ -387,16 +411,31 @@ class WireServer:
                                 pong_frame(**outer.pong_fields()),
                             )
                             continue
-                        try:
-                            resp = outer.handle_frame(z)
-                            if isinstance(resp, dict):
-                                resp = pack_arrays(resp)
-                        except Exception as e:
-                            # server-side failure: tell the CLIENT what
-                            # broke instead of closing with no diagnostics
-                            resp = error_frame(
-                                -3, f"{type(e).__name__}: {e}"
-                            )
+                        ctx = _propagation.extract(z)
+                        t0 = time.time()
+                        with _propagation.scope(ctx):
+                            try:
+                                resp = outer.handle_frame(z)
+                                if isinstance(resp, dict):
+                                    resp = pack_arrays(resp)
+                                if ctx:
+                                    outer.emit_event(
+                                        "wire_serve", op=frame_op(z), ok=1,
+                                        dur_s=round(time.time() - t0, 6),
+                                    )
+                            except Exception as e:
+                                # server-side failure: tell the CLIENT what
+                                # broke instead of closing with no
+                                # diagnostics
+                                resp = error_frame(
+                                    -3, f"{type(e).__name__}: {e}"
+                                )
+                                if ctx:
+                                    outer.emit_event(
+                                        "wire_serve", op=frame_op(z), ok=0,
+                                        error=type(e).__name__,
+                                        dur_s=round(time.time() - t0, 6),
+                                    )
                         send_msg(self.request, resp)
                 except (ConnectionError, OSError):
                     return
@@ -406,6 +445,7 @@ class WireServer:
             allow_reuse_address = True
 
         self._name = name or type(self).__name__
+        self._journal = journal  # private journal (None = process-global)
         self._test_delay_s = float(_test_delay_s)
         # live handler sockets
         self._conns: set[socket.socket] = set()  # guarded-by: _conns_lock
@@ -435,6 +475,19 @@ class WireServer:
 
     def handle_frame(self, z: dict[str, np.ndarray]) -> "bytes | dict":
         raise NotImplementedError
+
+    def emit_event(self, kind: str, **fields) -> None:
+        """Journal one record: to this server's private journal when one
+        was attached, else to the process-global one (either way a no-op
+        when the plane is off; a telemetry failure never fails a serve)."""
+        try:
+            if self._journal is not None:
+                if _journal.metrics.enabled():
+                    self._journal.emit(kind, **fields)
+            else:
+                _journal.emit(kind, **fields)
+        except Exception:
+            pass
 
     # -- chaos / lifecycle --
     def _log_name(self) -> str:
@@ -597,6 +650,10 @@ class RoundTripper:
 
         if self._auth_token is not None:
             fields["token"] = token_field(self._auth_token)
+        # trace-context propagation: when armed AND the ambient journal
+        # context carries a request_id, one extra frame field rides along
+        # (old servers ignore it); disabled, nothing is added — zero bytes
+        _propagation.inject(fields)
         req = pack_arrays(fields)
 
         def attempt_once() -> bytes:
@@ -775,6 +832,7 @@ __all__ = [
     "error_frame",
     "field_text",
     "frame_detail",
+    "frame_op",
     "pack_arrays",
     "pong_frame",
     "recv_exact",

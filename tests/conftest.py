@@ -67,3 +67,19 @@ from hydragnn_tpu.analysis.threadsan import (  # noqa: E402,F401
     threadsan,
     threadsan_module,
 )
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def telemetry_isolate():
+    """Scoped fresh-instance telemetry plane (telemetry.isolate): the
+    process metrics registry, span buffer, tracer timers, cost ledger,
+    journal, ambient context, and the enable/trace/propagate overrides are
+    swapped for fresh state for the duration of the test and restored on
+    exit — absolute-count assertions hold under any suite ordering without
+    manual reset calls. Yields the telemetry package."""
+    import hydragnn_tpu.telemetry as tel
+
+    with tel.isolate():
+        yield tel

@@ -1,0 +1,110 @@
+"""Sanctioned trace-propagation + cost-ledger patterns
+(hydragnn_tpu/telemetry/propagation.py, ledger.py).
+
+The trace-context layer and the compiled-cost ledger are HOST code shared
+by the router's dispatcher threads, a replica's wire handler threads, and
+the warm-up path. Their shape must stay silent under every GL rule:
+
+- ambient per-request ids live in a THREAD-LOCAL overlay merged over a
+  process-global base dict; the base is guarded by its own lock with a
+  ``# guarded-by:`` declaration (GL101), the overlay needs none (one
+  thread ever touches it), and reads hand back FRESH merged dicts, never
+  an alias of either guarded mutable (GL107);
+- the ledger's entry table lives behind one lock (GL101), records stamp
+  ``time.time()`` as a record FIELD for cross-process correlation — never
+  deadline arithmetic (GL105 stays quiet) — and snapshots copy;
+- scoped isolation swaps the module global for a fresh instance in ONE
+  rebind (atomic under the GIL) and restores it in ``finally`` — no lock
+  nesting at all, so GL102 has no edges to order;
+- wire inject/extract is pure dict-in/dict-out JSON framing: unknown or
+  torn context blobs degrade to an EMPTY context, and nothing here is
+  jit-reachable (GL001/GL002/GL003 have no surface) or spawns threads
+  (GL106 has nothing to own).
+"""
+import contextlib
+import json
+import threading
+import time
+
+_TLS = threading.local()  # per-thread overlay: no lock, no sharing
+
+
+class CleanContextBase:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids = {}  # guarded-by: _lock
+
+    def set(self, **ids):
+        with self._lock:
+            self._ids.update(ids)
+
+    def merged(self):
+        with self._lock:
+            base = dict(self._ids)  # fresh copy, never the guarded dict
+        overlay = getattr(_TLS, "overlay", None)
+        if overlay:
+            base.update(overlay)
+        return base
+
+
+@contextlib.contextmanager
+def clean_scoped(base, **ids):
+    prev = getattr(_TLS, "overlay", None)
+    nxt = dict(prev or {})
+    nxt.update(ids)
+    _TLS.overlay = nxt
+    try:
+        yield
+    finally:
+        _TLS.overlay = prev
+
+
+def clean_inject(fields, base):
+    ctx = base.merged()
+    if ctx.get("request_id") is None:
+        return fields  # propagation off / no ambient request: zero bytes
+    fields["_trace_ctx"] = json.dumps(ctx, separators=(",", ":"))
+    return fields
+
+
+def clean_extract(frame):
+    blob = frame.get("_trace_ctx")
+    if blob is None:
+        return {}
+    try:
+        ctx = json.loads(blob)
+    except (ValueError, TypeError):
+        return {}  # torn/foreign blob: degrade to untraced, never raise
+    return ctx if isinstance(ctx, dict) else {}
+
+
+class CleanLedger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded-by: _lock
+
+    def record(self, key, cost):
+        # wall stamp as a record FIELD (cross-process correlation) — never
+        # compared against a deadline
+        entry = dict(cost)
+        entry["t_wall"] = time.time()
+        with self._lock:
+            self._entries[key] = entry
+
+    def entries(self):
+        with self._lock:
+            return [dict(self._entries[k]) for k in sorted(self._entries)]
+
+
+LEDGER = CleanLedger()
+
+
+@contextlib.contextmanager
+def clean_isolated_ledger():
+    global LEDGER
+    fresh = CleanLedger()
+    prev, LEDGER = LEDGER, fresh
+    try:
+        yield fresh
+    finally:
+        LEDGER = prev

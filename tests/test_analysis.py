@@ -227,6 +227,18 @@ def test_telemetry_plane_shape_is_clean():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_trace_propagation_shape_is_clean():
+    """The trace-propagation + cost-ledger shape (hydragnn_tpu/telemetry/
+    propagation.py, ledger.py: thread-local context overlay merged over a
+    lock-guarded base with fresh-dict reads, a lock-guarded ledger table
+    whose wall stamp is a record field, single-rebind scoped isolation
+    with finally-restore, tolerant JSON wire framing) is sanctioned host
+    code: every rule — GL101/GL102/GL105/GL107 above all — must stay
+    silent on it."""
+    findings = analyze([str(FIXTURES / "trace_propagation_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_screen_planner_shape_is_clean():
     """The bulk-screening engine's shape (hydragnn_tpu/screen: an owned
     daemon staging thread handing fetched+collated blocks to the consumer

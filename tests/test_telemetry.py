@@ -57,23 +57,16 @@ def _threadsan(threadsan_module):
 
 @pytest.fixture(autouse=True)
 def _fresh_telemetry():
-    """Every test starts (and leaves) the plane pristine: no override, no
-    open journal, empty context/registry/trace buffer."""
-    def _reset():
+    """Every test starts (and leaves) the plane pristine via the scoped
+    fresh-instance API (``telemetry.isolate``): registry, span buffer,
+    tracer timers, cost ledger, journal, context and every override are
+    swapped for fresh state and restored on exit — absolute-count
+    assertions hold under any full-suite ordering with no manual reset
+    calls (``isolated_timers`` covers the process-global Timer registry
+    the old reset-in-place approach had to special-case)."""
+    with tel.isolate():
         tel.configure(None)
-        tel.close_journal()
-        tel.clear_context()
-        tel.reset_metrics()
-        tel.reset_trace()
-        # The aggregate Timer registry in utils.tracer is process-global and
-        # is NOT covered by reset_trace(); earlier train-loop tests leave
-        # their span counts behind, which breaks the absolute count
-        # assertions below under full-suite ordering.
-        tr.reset()
-
-    _reset()
-    yield
-    _reset()
+        yield
 
 
 # -- registry -----------------------------------------------------------------
@@ -176,7 +169,12 @@ def test_flags_registered():
     assert flags.TELEMETRY.default is True
     assert flags.TRACE_EVENTS.name == "HYDRAGNN_TRACE_EVENTS"
     assert flags.TRACE_EVENTS.default is False
+    assert flags.TRACE_PROPAGATE.name == "HYDRAGNN_TRACE_PROPAGATE"
+    assert flags.TRACE_PROPAGATE.default is True
+    assert flags.LEDGER.name == "HYDRAGNN_LEDGER"
+    assert flags.LEDGER.default is None
     assert "HYDRAGNN_TELEMETRY" in flags.describe()
+    assert "HYDRAGNN_LEDGER" in flags.describe()
 
 
 def test_telemetry_config_block_defaults_and_unknown_keys():

@@ -1,0 +1,493 @@
+"""Fleet-wide distributed tracing + compiled-program cost observatory.
+
+The PR 18 acceptance suite:
+
+* wire back-compat BOTH directions — a legacy frame (no ``_trace_ctx``)
+  is served by a new server with zero trace records; a new traced frame
+  is served by a handler that only reads its known keys (the old-peer
+  shape) without error;
+* propagation disabled adds ZERO wire bytes (byte-identical frames);
+* one real-socket fleet predict (fake endpoints, unit cost) lands
+  journal records in the router's AND the replica's log dirs sharing ONE
+  ``request_id``, and ``python -m hydragnn_tpu.telemetry fleet`` renders
+  them as one cross-process timeline (plus a merged per-pid trace);
+* a forced ShardedStore failover fetch emits per-hop ``store_hop``
+  records naming the quarantined and the winning peer under one id;
+* the cost ledger captures real flops / bytes-accessed / peak-bytes on
+  CPU at an ``aot_compile`` site, round-trips through save/load, and the
+  diff sentinel passes on identical ledgers while failing LOUDLY on
+  seeded cost inflation;
+* the CLI error paths: missing/empty journals exit nonzero with one
+  line naming the path; a torn trace.json never costs the report.
+
+Every test runs under the module lock-order sanitizer and a scoped
+fresh-instance telemetry plane (``telemetry.isolate`` via the
+``telemetry_isolate`` fixture) — no process-global state leaks in or
+out.
+"""
+
+import json
+import os
+import types
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import hydragnn_tpu.telemetry as tel
+from hydragnn_tpu.telemetry import ledger, propagation
+from hydragnn_tpu.telemetry.cli import fleet_main, ledger_main, main as cli_main
+from hydragnn_tpu.telemetry.journal import EventJournal, read_journal
+from hydragnn_tpu.utils import wire
+from hydragnn_tpu.utils.compile_cache import aot_compile, shape_structs
+from hydragnn_tpu.utils.retry import RetryPolicy
+
+from conftest import random_molecule_samples
+
+_ONE = RetryPolicy(attempts=1)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _threadsan(threadsan_module):
+    """Wire server/client, router, store, journal and ledger locks all run
+    under the lock-order sanitizer for the whole module; teardown asserts
+    the acquisition graph stays cycle-free."""
+    yield threadsan_module
+
+
+@pytest.fixture(autouse=True)
+def _fresh(telemetry_isolate):
+    """Every test gets (and leaves behind) a pristine scoped telemetry
+    plane — fresh registry/buffer/ledger/journal, overrides restored."""
+    yield telemetry_isolate
+
+
+# -- wire propagation + back-compat -------------------------------------------
+
+
+class _EchoServer(wire.WireServer):
+    """The OLD-PEER handler shape: reads ONLY the keys it knows (``x``),
+    never looks for a trace-context field — new traced frames must serve
+    through it unchanged."""
+
+    def handle_frame(self, z):
+        return {"n": np.asarray(1, np.int64), "y": np.asarray(z["x"]) * 2}
+
+
+def test_inject_extract_roundtrip_and_disabled_is_zero_bytes():
+    fields = {"x": np.arange(4, dtype=np.float64)}
+    # no ambient request_id: nothing to propagate, nothing added
+    propagation.inject(fields)
+    assert propagation.TRACE_FIELD not in fields
+    baseline = len(wire.pack_arrays(dict(fields)))
+
+    with tel.scoped_context(request_id="rid0123", run_id="runA"):
+        injected = {"x": np.arange(4, dtype=np.float64)}
+        propagation.inject(injected)
+        assert propagation.TRACE_FIELD in injected
+        ctx = propagation.extract(wire.unpack_arrays(
+            wire.pack_arrays(injected)))
+        assert ctx["request_id"] == "rid0123" and ctx["run_id"] == "runA"
+
+        # disabled: byte-identical to the never-injected frame
+        tel.set_propagate_enabled(False)
+        off = {"x": np.arange(4, dtype=np.float64)}
+        propagation.inject(off)
+        assert propagation.TRACE_FIELD not in off
+        assert len(wire.pack_arrays(off)) == baseline
+
+    # legacy frame (no trace field): extract degrades to untraced, never
+    # raises — and garbage blobs degrade the same way
+    assert propagation.extract({"x": np.zeros(1)}) == {}
+    assert propagation.extract(
+        {propagation.TRACE_FIELD: np.frombuffer(b"not json", dtype=np.uint8)}
+    ) == {}
+
+
+def test_wire_backcompat_both_directions(tmp_path):
+    """Old client -> new server: an uninjected frame serves with ZERO
+    trace records. New client -> old-shape handler: the traced frame's
+    extra field rides through codec + dispatch untouched."""
+    journal = EventJournal(str(tmp_path / "events.jsonl"), run_id="srv")
+    server = _EchoServer(name="echo", journal=journal)
+    rt = wire.RoundTripper(5.0)
+    try:
+        # direction 1: legacy client (propagation off => no injection)
+        tel.set_propagate_enabled(False)
+        z = rt.round_trip(("e", server.port), "127.0.0.1", server.port,
+                          policy=_ONE, x=np.arange(3, dtype=np.float64))
+        np.testing.assert_array_equal(z["y"], np.arange(3) * 2.0)
+
+        # direction 2: new traced client against the old handler shape
+        tel.set_propagate_enabled(True)
+        with tel.scoped_context(request_id="ridAB"):
+            z = rt.round_trip(("e", server.port), "127.0.0.1", server.port,
+                              policy=_ONE, x=np.arange(3, dtype=np.float64))
+        np.testing.assert_array_equal(z["y"], np.arange(3) * 2.0)
+    finally:
+        rt.close()
+        server.close()
+        journal.close()
+    recs = read_journal(str(tmp_path / "events.jsonl"))
+    # the legacy frame journaled NOTHING; the traced frame journaled one
+    # wire_serve carrying the propagated id
+    assert [r["kind"] for r in recs] == ["wire_serve"]
+    assert recs[0]["request_id"] == "ridAB" and recs[0]["ok"] == 1
+
+
+# -- fleet predict: one request_id across processes ---------------------------
+
+
+class _FakeEndpoint:
+    def __init__(self):
+        self.cfg = types.SimpleNamespace(quantize=False)
+        self.executables_quant = {}
+
+
+class _FakePredictServer:
+    """Just enough PredictionServer surface for a routed predict (unit
+    cost, no AOT warm-up): submit -> resolved Future with one head."""
+
+    def __init__(self):
+        self._models = {"gin": _FakeEndpoint()}
+
+    def submit(self, model, sample):
+        fut = Future()
+        fut.set_result({
+            "heads": [np.asarray(sample.x, np.float64).sum(axis=0)],
+            "latency_s": 0.001,
+        })
+        return fut
+
+    def stats(self):
+        return {"gin": {"queue_depth": 0, "shed": 0, "served": 1,
+                        "submitted": 1}}
+
+
+def test_fleet_predict_shares_one_request_id_across_dirs(tmp_path, capsys):
+    """THE tentpole acceptance: admission -> dispatch -> replica execute
+    -> reply -> cache fill of one routed predict lands records in the
+    router's AND the replica's journal dirs under ONE request_id, and the
+    ``fleet`` CLI merges them into one ordered cross-process timeline."""
+    from hydragnn_tpu.serve import FleetRouter, ReplicaHost
+
+    router_dir = tmp_path / "router"
+    replica_dir = tmp_path / "replica0"
+    tel.open_journal(file=str(router_dir / "events.jsonl"), run_id="router")
+    rep_journal = EventJournal(str(replica_dir / "events.jsonl"),
+                               run_id="replica0")
+    sample = random_molecule_samples(1, seed=11)[0]
+    host = ReplicaHost(_FakePredictServer(), journal=rep_journal)
+    router = FleetRouter({"peer_timeout": 5.0, "cache_bytes": 1 << 16})
+    try:
+        router.attach("127.0.0.1", host.port)
+        router.start()
+        result = router.submit("gin", sample).result(timeout=30)
+        assert len(result["heads"]) == 1
+        # a duplicate is answered from the router cache — its hit record
+        # joins the SECOND request's timeline
+        dup = router.submit("gin", sample).result(timeout=30)
+        assert dup.get("cached") is True
+    finally:
+        router.stop()
+        host.close()
+        rep_journal.close()
+        tel.close_journal()
+
+    router_recs = read_journal(str(router_dir / "events.jsonl"))
+    rep_recs = read_journal(str(replica_dir / "events.jsonl"))
+    kinds = {r["kind"] for r in router_recs}
+    assert {"fleet_admit", "fleet_dispatch", "fleet_reply",
+            "fleet_cache_fill", "fleet_cache_hit"} <= kinds
+    # ONE request id spans the first predict's records in BOTH dirs
+    rid = next(r["request_id"] for r in router_recs
+               if r["kind"] == "fleet_admit")
+    first = [r for r in router_recs if r.get("request_id") == rid]
+    assert {"fleet_admit", "fleet_dispatch", "fleet_reply",
+            "fleet_cache_fill"} <= {r["kind"] for r in first}
+    rep_traced = [r for r in rep_recs if r.get("request_id") == rid]
+    assert {"replica_execute", "wire_serve"} <= {r["kind"] for r in rep_traced}
+
+    # the fleet CLI renders the merge as one ordered timeline
+    merged_trace = str(tmp_path / "fleet_trace.json")
+    rc = fleet_main([str(router_dir), str(replica_dir),
+                     "--trace-out", merged_trace])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert rid in out
+    assert "2 process(es)" in out
+    assert "router" in out and "replica0" in out
+    # both sources' records interleave under the request header, ordered
+    req_section = out.split("fleet timeline")[0]
+    i_admit = req_section.index("fleet_admit")
+    i_exec = req_section.index("replica_execute")
+    i_reply = req_section.index("fleet_reply")
+    assert i_admit < i_exec < i_reply
+
+
+def test_fleet_predict_propagation_disabled_emits_nothing(tmp_path):
+    """The off arm: no request ids are minted, neither journal gains a
+    single per-request record, and the predict still answers."""
+    from hydragnn_tpu.serve import FleetRouter, ReplicaHost
+
+    tel.set_propagate_enabled(False)
+    tel.open_journal(file=str(tmp_path / "router" / "events.jsonl"),
+                     run_id="router")
+    rep_journal = EventJournal(str(tmp_path / "replica0" / "events.jsonl"),
+                               run_id="replica0")
+    sample = random_molecule_samples(1, seed=12)[0]
+    host = ReplicaHost(_FakePredictServer(), journal=rep_journal)
+    router = FleetRouter({"peer_timeout": 5.0, "cache_bytes": 0})
+    try:
+        router.attach("127.0.0.1", host.port)
+        router.start()
+        result = router.submit("gin", sample).result(timeout=30)
+        assert len(result["heads"]) == 1
+    finally:
+        router.stop()
+        host.close()
+        rep_journal.close()
+        tel.close_journal()
+    assert read_journal(str(tmp_path / "router" / "events.jsonl")) == []
+    assert read_journal(str(tmp_path / "replica0" / "events.jsonl")) == []
+
+
+# -- sharded store: failover hops under one id --------------------------------
+
+
+def test_store_forced_failover_hops_share_request_id(tmp_path):
+    """Kill one of R=2 owners and FORCE the dead peer first in rotation:
+    the fetch emits hop 0 ``outcome=quarantined`` naming the dead rank
+    and hop 1 ``outcome=served`` naming the winner, both under one
+    request_id the whole walk (and any downstream records) share."""
+    import warnings
+
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+    from hydragnn_tpu.datasets import deterministic_graph_data
+
+    samples = deterministic_graph_data(number_configurations=8, seed=5)
+    p_local, p_remote = str(tmp_path / "l.gpk"), str(tmp_path / "r.gpk")
+    PackedWriter(samples[:4], p_local)
+    PackedWriter(samples[4:], p_remote)
+    replicas = [
+        ShardedStore(p_remote, 4, 8,
+                     peers=[("127.0.0.1", 0, 0, 4), ("127.0.0.1", 0, 4, 8)])
+        for _ in range(2)
+    ]
+    peers = [("127.0.0.1", 0, 0, 4)] + [
+        ("127.0.0.1", r.server.port, 4, 8) for r in replicas
+    ]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        client = ShardedStore(p_local, 0, 4, peers=peers,
+                              replication_factor=2)
+    tel.open_journal(file=str(tmp_path / "logs" / "events.jsonl"),
+                     run_id="store")
+    try:
+        dead = replicas[0]
+        dead_rank = next(r for r, p in enumerate(client.peers)
+                         if p[1] == dead.server.port)
+        dead.close()
+        # deterministic failover: the dead peer is tried FIRST
+        client._replica_order = lambda ranks: sorted(
+            ranks, key=lambda r: r != dead_rank)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            got = client.fetch([6])
+        np.testing.assert_array_equal(
+            np.asarray(got[0].x), np.asarray(samples[6].x))
+    finally:
+        client.close()
+        for r in replicas:
+            r.close()
+        tel.close_journal()
+
+    recs = read_journal(str(tmp_path / "logs" / "events.jsonl"))
+    hops = [r for r in recs if r["kind"] == "store_hop"]
+    assert len(hops) >= 2
+    rids = {r.get("request_id") for r in hops}
+    assert len(rids) == 1 and None not in rids
+    quarantined = [r for r in hops if r["outcome"] == "quarantined"]
+    served = [r for r in hops if r["outcome"] == "served"]
+    assert quarantined and served
+    assert quarantined[0]["peer"] == dead_rank
+    assert served[0]["peer"] != dead_rank
+    assert served[0]["failed_over"] is True
+    assert quarantined[0]["hop"] < served[0]["hop"]
+
+
+def test_store_untraced_fetch_emits_no_hops(tmp_path):
+    """Propagation off: the failover walk journals nothing (the off arm
+    of the bench budget is literally zero records)."""
+    import warnings
+
+    from hydragnn_tpu.datasets.packed import PackedWriter
+    from hydragnn_tpu.datasets.sharded import ShardedStore
+
+    samples = random_molecule_samples(4, seed=3)
+    p_local, p_remote = str(tmp_path / "l.gpk"), str(tmp_path / "r.gpk")
+    PackedWriter(samples[:2], p_local)
+    PackedWriter(samples[2:], p_remote)
+    remote = ShardedStore(p_remote, 2, 4,
+                          peers=[("127.0.0.1", 0, 0, 2),
+                                 ("127.0.0.1", 0, 2, 4)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        client = ShardedStore(
+            p_local, 0, 2,
+            peers=[("127.0.0.1", 0, 0, 2),
+                   ("127.0.0.1", remote.server.port, 2, 4)])
+    tel.set_propagate_enabled(False)
+    tel.open_journal(file=str(tmp_path / "logs" / "events.jsonl"),
+                     run_id="store")
+    try:
+        got = client.fetch([3])
+        np.testing.assert_array_equal(
+            np.asarray(got[0].x), np.asarray(samples[3].x))
+    finally:
+        client.close()
+        remote.close()
+        tel.close_journal()
+    recs = read_journal(str(tmp_path / "logs" / "events.jsonl"))
+    assert [r for r in recs if r["kind"] == "store_hop"] == []
+
+
+# -- cost ledger --------------------------------------------------------------
+
+
+def _aot_toy(n=16):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(a, b):
+        return jnp.tanh(a @ b).sum()
+
+    sig = shape_structs(np.zeros((n, n), np.float32))
+    return aot_compile(f, sig, sig, ledger_entry={
+        "model": "toy", "bucket": (n, n), "kind": "predict",
+        "precision": "float32",
+    })
+
+
+def test_ledger_captures_real_cost_on_cpu(tmp_path):
+    """An aot_compile site populates flops / bytes-accessed / peak-bytes
+    ON CPU (XLA's own artifact introspection), stamps compile_s and the
+    lowering count, and the document round-trips through save/load."""
+    _aot_toy()
+    entries = ledger.entries()
+    assert len(entries) == 1
+    e = entries[0]
+    assert e["model"] == "toy" and e["kind"] == "predict"
+    assert e["bucket"] == [16, 16] and e["precision"] == "float32"
+    assert e["flops"] > 0
+    assert e["bytes_accessed"] > 0
+    assert e["peak_bytes"] > 0
+    assert e["compile_s"] > 0
+    assert isinstance(e["lowerings_at_capture"], int)
+
+    path = str(tmp_path / "ledger.json")
+    assert ledger.save(path) == path
+    doc = ledger.load(path)
+    assert doc["schema"] == ledger.SCHEMA_VERSION
+    assert doc["entries"] == entries
+    assert "lowerings" in doc and "backend" in doc
+
+    # re-recording the same signature overwrites, never duplicates
+    _aot_toy()
+    assert len(ledger.entries()) == 1
+
+
+def test_ledger_diff_sentinel_passes_identical_fails_inflated(tmp_path):
+    """The regression sentinel: identical ledgers pass; seeded flops
+    inflation beyond tolerance fails LOUDLY (exit 1 through the CLI);
+    one-sided entries are reported but never fail."""
+    _aot_toy()
+    base_path = str(tmp_path / "base.json")
+    ledger.save(base_path)
+    base = ledger.load(base_path)
+
+    assert ledger.diff(base, base)["ok"] is True
+
+    inflated = json.loads(json.dumps(base))
+    inflated["entries"][0]["flops"] *= 1.5
+    res = ledger.diff(base, inflated)
+    assert res["ok"] is False
+    assert res["regressions"][0]["metric"] == "flops"
+    # shrinkage is an improvement, not a failure
+    res_rev = ledger.diff(inflated, base)
+    assert res_rev["ok"] is True and res_rev["improvements"]
+    # a new bucket on either side is news, not a regression
+    extra = json.loads(json.dumps(base))
+    extra["entries"].append(dict(base["entries"][0], model="other"))
+    assert ledger.diff(base, extra)["ok"] is True
+
+    cur_path = str(tmp_path / "cur.json")
+    with open(cur_path, "w") as f:
+        json.dump(inflated, f)
+    assert ledger_main([base_path, "--baseline", base_path]) == 0
+    assert ledger_main([cur_path, "--baseline", base_path]) == 1
+    # tolerance is honored: 60% headroom swallows the seeded 50%
+    assert ledger_main([cur_path, "--baseline", base_path,
+                        "--tolerance", "0.6"]) == 0
+
+
+def test_ledger_flag_gates_capture_and_save(tmp_path, monkeypatch):
+    monkeypatch.setenv("HYDRAGNN_LEDGER", "0")
+    assert not ledger.capture_enabled()
+    assert ledger.record(object()) is None
+    assert ledger.save_path() is None
+    monkeypatch.setenv("HYDRAGNN_LEDGER", "1")
+    assert ledger.save_path() == os.path.join(".", "logs", "ledger.json")
+    custom = str(tmp_path / "custom.json")
+    monkeypatch.setenv("HYDRAGNN_LEDGER", custom)
+    assert ledger.save_path() == custom
+    # empty ledger: maybe_save writes nothing (absence is unambiguous)
+    assert ledger.maybe_save() is None
+    _aot_toy()
+    assert ledger.maybe_save() == custom
+    assert ledger.load(custom)["entries"]
+
+
+# -- CLI error paths ----------------------------------------------------------
+
+
+def test_cli_missing_and_empty_journals_exit_nonzero(tmp_path, capsys):
+    missing = str(tmp_path / "nowhere")
+    assert cli_main([missing]) == 2
+    err = capsys.readouterr().err
+    assert err.count("\n") == 1  # ONE line, no usage dump, no traceback
+    assert missing in err
+
+    empty_dir = tmp_path / "run0"
+    empty_dir.mkdir()
+    (empty_dir / "events.jsonl").write_text("")
+    assert cli_main([str(empty_dir)]) == 2
+    err = capsys.readouterr().err
+    assert "empty events journal" in err
+    assert str(empty_dir / "events.jsonl") in err
+
+    # ledger subcommand: same one-line contract
+    assert ledger_main([str(tmp_path / "no_ledger.json")]) == 2
+    assert "cannot read ledger" in capsys.readouterr().err
+
+
+def test_cli_tolerates_torn_trace_json(tmp_path, capsys):
+    run = tmp_path / "run1"
+    run.mkdir()
+    with open(run / "events.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "run_start", "t_wall": 1.0, "seq": 0,
+                            "run_id": "r"}) + "\n")
+    (run / "trace.json").write_text('{"traceEvents": [{"ph": "X", "na')
+    assert cli_main([str(run)]) == 0
+    out = capsys.readouterr().out
+    assert "unreadable trace.json" in out and "run_start" in out
+
+    # the fleet merge skips the torn trace with a warning, never raises
+    merged = str(tmp_path / "merged.json")
+    assert fleet_main([str(run), "--trace-out", merged]) == 0
+    captured = capsys.readouterr()
+    assert "unreadable trace.json" in captured.err
+    assert "no usable trace.json" in captured.out
+    assert not os.path.exists(merged)
