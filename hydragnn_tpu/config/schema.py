@@ -357,6 +357,27 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     arch.setdefault("freeze_conv_layers", False)
     arch.setdefault("activation_function", "relu")
     arch.setdefault("SyncBatchNorm", False)
+    # halo-exchange graph partitioning (parallel/halo.py): the
+    # Architecture.halo block's defaults ARE the HaloConfig dataclass field
+    # defaults (same single-source pattern); HYDRAGNN_HALO overrides
+    # `enabled` at routing time.
+    halo_cfg = arch.setdefault("halo", {})
+    if not isinstance(halo_cfg, dict):
+        raise ValueError(
+            f"Architecture.halo must be a dict, got {type(halo_cfg).__name__}"
+        )
+    from ..parallel.halo import HaloConfig, halo_config_defaults
+
+    halo_defaults = halo_config_defaults()
+    unknown_halo = set(halo_cfg) - set(halo_defaults)
+    if unknown_halo:
+        raise ValueError(
+            f"Unknown Architecture.halo key(s) {sorted(unknown_halo)}; "
+            f"known: {sorted(halo_defaults)}"
+        )
+    for key, val in halo_defaults.items():
+        halo_cfg.setdefault(key, val)
+    HaloConfig(**halo_cfg).validate()  # one range-check implementation
     training.setdefault("conv_checkpointing", False)
     # K train steps per device dispatch (train/superstep.py); env override
     # HYDRAGNN_SUPERSTEP wins at loop time
@@ -555,6 +576,10 @@ class ModelSpec:
     freeze_conv_layers: bool = False
     initial_bias: float | None = None
     sync_batch_norm: bool = False
+    # mesh axis name feature-norm statistics must psum over — set ONLY by the
+    # halo-partitioned step factory (dataclasses.replace), never from config:
+    # a partitioned node set has no correct per-device statistics
+    bn_sync_axis: str | None = None
     conv_checkpointing: bool = False
     var_output: bool = False
     graph_size_variable: bool = False

@@ -145,8 +145,14 @@ class HydraModel(nn.Module):
         # some stacks (SchNet) use identity feature layers in the reference
         # SyncBatchNorm (reference distributed.py:415-416, config key
         # Architecture.SyncBatchNorm): stats pmean'd over the axis the SPMD
-        # steps bind; requires running under a parallel step's vmap
-        bn_axis = SYNC_BN_AXIS if spec.sync_batch_norm else None
+        # steps bind; requires running under a parallel step's vmap.
+        # ``bn_sync_axis`` overrides with a MESH axis name instead: the
+        # halo-partitioned step runs under shard_map where the node set is
+        # split across devices, so feature-norm statistics are only correct
+        # when the masked sums are psum'd over the data axis.
+        bn_axis = spec.bn_sync_axis or (
+            SYNC_BN_AXIS if spec.sync_batch_norm else None
+        )
         self.feature_layers = [
             (
                 MaskedBatchNorm(name=f"feature_norm_{i}", axis_name=bn_axis)
@@ -295,8 +301,15 @@ class HydraModel(nn.Module):
         inv, equiv = self.embed(batch)
         return self.conv_block(0, inv, equiv, batch, train)
 
-    def encode(self, batch: GraphBatch, train: bool = False):
-        """Run the conv stack; returns (node_features, equiv_features)."""
+    def encode(self, batch: GraphBatch, train: bool = False, layer_hook=None):
+        """Run the conv stack; returns (node_features, equiv_features).
+
+        ``layer_hook(inv, equiv) -> (inv, equiv)`` runs BEFORE every conv
+        layer after the first — the seam the halo-exchange route uses to
+        refresh boundary-node features over the mesh (``parallel/halo.py``):
+        layer 0 reads collate-time halo copies, every later layer reads rows
+        re-fetched from their owner device. Single-device and replicated
+        paths pass None and trace the exact historical program."""
         conv_cls = CONV_REGISTRY[self.spec.mpnn_type]
         # MACE: no inter-layer activation; heads read concatenated per-layer
         # scalars (our static-shape take on the reference's summed per-layer
@@ -306,6 +319,8 @@ class HydraModel(nn.Module):
         inv, equiv = self.embed(batch)
         layer_outs = []
         for i in range(len(self.graph_convs)):
+            if layer_hook is not None and i > 0:
+                inv, equiv = layer_hook(inv, equiv)
             inv, equiv = self.conv_block(i, inv, equiv, batch, train)
             if collect:
                 layer_outs.append(inv)
@@ -351,7 +366,7 @@ class HydraModel(nn.Module):
             return x, batch.pos
         return batch.x, batch.pos
 
-    def pool(self, x: Array, batch: GraphBatch) -> Array:
+    def pool(self, x: Array, batch: GraphBatch, pool_reduce=None) -> Array:
         pooled = segment.global_pool(
             self.spec.graph_pooling,
             x * batch.node_mask[:, None],
@@ -359,6 +374,12 @@ class HydraModel(nn.Module):
             batch.num_graphs,
             hints=batch,
         )
+        if pool_reduce is not None:
+            # partitioned node sets (halo route): each device pooled only its
+            # owned rows — the hook merges the per-device partials into the
+            # union-graph readout (psum/weighted-mean/pmax per pooling kind)
+            # BEFORE any nonlinear head consumes them
+            pooled = pool_reduce(pooled)
         if (
             self.spec.use_graph_attr_conditioning
             and self.spec.graph_attr_conditioning_mode == "fuse_pool"
@@ -370,16 +391,17 @@ class HydraModel(nn.Module):
         return pooled
 
     # -- full forward --------------------------------------------------------
-    def __call__(self, batch: GraphBatch, train: bool = False):
-        inv, equiv = self.encode(batch, train)
-        return self.decode(inv, equiv, batch, train)
+    def __call__(self, batch: GraphBatch, train: bool = False,
+                 layer_hook=None, pool_reduce=None):
+        inv, equiv = self.encode(batch, train, layer_hook=layer_hook)
+        return self.decode(inv, equiv, batch, train, pool_reduce=pool_reduce)
 
     def decode(self, inv: Array, equiv: Array, batch: GraphBatch,
-               train: bool = False):
+               train: bool = False, pool_reduce=None):
         """Pooling + multi-head decoders on encoded node features — the
         pipeline epilogue (everything after the conv stack)."""
         spec = self.spec
-        x_graph = self.pool(inv, batch)
+        x_graph = self.pool(inv, batch, pool_reduce=pool_reduce)
 
         outputs = []
         outputs_var = []
@@ -437,9 +459,15 @@ class HydraModel(nn.Module):
         return outputs
 
     # -- loss ----------------------------------------------------------------
-    def loss(self, pred, batch: GraphBatch):
+    def loss(self, pred, batch: GraphBatch, loss_axis: str | None = None):
         """Weighted multi-task loss (reference ``loss_hpweighted``,
-        ``Base.py:879-906``). Returns (total, [per-task losses])."""
+        ``Base.py:879-906``). Returns (total, [per-task losses]).
+
+        ``loss_axis``: mesh axis name when the batch's NODE rows are
+        partitioned across devices (halo route) — each masked mean then
+        psums numerator and denominator over the axis so every device holds
+        the exact union-batch loss (graph rows are replicated there, which
+        the psum'd ratio absorbs unchanged)."""
         spec = self.spec
         var = None
         if spec.var_output:
@@ -455,9 +483,11 @@ class HydraModel(nn.Module):
                 target = batch.node_y[:, col : col + dim]
                 mask = batch.node_mask
             if var is not None:
-                task_loss = loss_fn(pred[ihead], target, mask, var[ihead])
+                task_loss = loss_fn(pred[ihead], target, mask, var[ihead],
+                                    axis_name=loss_axis)
             else:
-                task_loss = loss_fn(pred[ihead], target, mask)
+                task_loss = loss_fn(pred[ihead], target, mask,
+                                    axis_name=loss_axis)
             tot = tot + task_loss * spec.task_weights[ihead]
             tasks.append(task_loss)
         return tot, tasks

@@ -41,44 +41,64 @@ def get_activation(name: str) -> Callable[[Array], Array]:
         )
 
 
-def masked_mse(pred: Array, target: Array, mask: Array) -> Array:
+def _masked_mean(terms: Array, mask: Array, per_row: int,
+                 axis_name: str | None = None) -> Array:
+    """sum(terms) / (real rows x row width), with numerator AND denominator
+    optionally psum'd over a mapped mesh axis first. That makes every masked
+    loss exact over a row set PARTITIONED across devices (the halo-exchange
+    route: each device holds only its owned nodes) — a mean of per-device
+    means would weight devices, not rows. Rows replicated on every device
+    (graph-level targets) scale numerator and denominator by the same device
+    count, so the psum'd ratio is unchanged there too."""
+    s = terms.sum()
+    n = mask.sum() * per_row
+    if axis_name is not None:
+        s = jax.lax.psum(s, axis_name)
+        n = jax.lax.psum(n, axis_name)
+    return s / jnp.maximum(n, 1.0)
+
+
+def masked_mse(pred: Array, target: Array, mask: Array,
+               axis_name: str | None = None) -> Array:
     """Mean squared error over real (mask=1) rows only."""
     mask = mask.reshape(mask.shape[0], *([1] * (pred.ndim - 1)))
     se = (pred - target) ** 2 * mask
-    n_real = jnp.maximum(mask.sum(), 1.0)
-    return se.sum() / (n_real * pred.shape[-1])
+    return _masked_mean(se, mask, pred.shape[-1], axis_name)
 
 
-def masked_mae(pred: Array, target: Array, mask: Array) -> Array:
+def masked_mae(pred: Array, target: Array, mask: Array,
+               axis_name: str | None = None) -> Array:
     mask = mask.reshape(mask.shape[0], *([1] * (pred.ndim - 1)))
     ae = jnp.abs(pred - target) * mask
-    n_real = jnp.maximum(mask.sum(), 1.0)
-    return ae.sum() / (n_real * pred.shape[-1])
+    return _masked_mean(ae, mask, pred.shape[-1], axis_name)
 
 
-def masked_rmse(pred: Array, target: Array, mask: Array) -> Array:
-    return jnp.sqrt(masked_mse(pred, target, mask) + 1e-16)
+def masked_rmse(pred: Array, target: Array, mask: Array,
+                axis_name: str | None = None) -> Array:
+    # sqrt OUTSIDE the (cross-device) mean: the global mse then one sqrt —
+    # a psum of per-device rmse values would not be any rmse
+    return jnp.sqrt(masked_mse(pred, target, mask, axis_name) + 1e-16)
 
 
-def masked_smooth_l1(pred: Array, target: Array, mask: Array) -> Array:
+def masked_smooth_l1(pred: Array, target: Array, mask: Array,
+                     axis_name: str | None = None) -> Array:
     """torch SmoothL1Loss (beta=1): 0.5 d^2 for |d|<1 else |d|-0.5, mean over
     real rows (reference loss_function_selection, model.py:54-55)."""
     mask = mask.reshape(mask.shape[0], *([1] * (pred.ndim - 1)))
     d = jnp.abs(pred - target)
     huber = jnp.where(d < 1.0, 0.5 * d**2, d - 0.5) * mask
-    n_real = jnp.maximum(mask.sum(), 1.0)
-    return huber.sum() / (n_real * pred.shape[-1])
+    return _masked_mean(huber, mask, pred.shape[-1], axis_name)
 
 
-def masked_gaussian_nll(pred: Array, target: Array, mask: Array, var: Array) -> Array:
+def masked_gaussian_nll(pred: Array, target: Array, mask: Array, var: Array,
+                        axis_name: str | None = None) -> Array:
     """torch.nn.GaussianNLLLoss semantics: 0.5*(log(var) + (x-mu)^2/var),
     var clamped below at eps, mean reduction over real rows."""
     eps = 1e-6
     var = jnp.maximum(var, eps)
     mask = mask.reshape(mask.shape[0], *([1] * (pred.ndim - 1)))
     nll = 0.5 * (jnp.log(var) + (pred - target) ** 2 / var) * mask
-    n_real = jnp.maximum(mask.sum(), 1.0)
-    return nll.sum() / (n_real * pred.shape[-1])
+    return _masked_mean(nll, mask, pred.shape[-1], axis_name)
 
 
 _LOSSES = {

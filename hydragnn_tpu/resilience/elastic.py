@@ -306,6 +306,13 @@ class ElasticController:
             return mesh, "restart_fallback", (
                 "edge-sharded placement has no resharded stack equivalent"
             )
+        from ..parallel.halo import halo_enabled
+
+        if halo_enabled(arch):
+            return mesh, "restart_fallback", (
+                "halo partition count is baked into the exchange plan and "
+                "the shard_map program"
+            )
         if mesh.axis_names == ("stage",):
             return mesh, "restart_fallback", (
                 "pipeline stage count is baked into the model partitioning"

@@ -132,11 +132,18 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
         if pop_n > 1:
             arch_cfg = config["NeuralNetwork"].get("Architecture", {})
             par_mode = str(arch_cfg.get("parallelism") or "data").lower()
-            if par_mode != "data" or arch_cfg.get("edge_sharding"):
+            from .parallel.halo import halo_enabled as _halo_enabled
+
+            if (
+                par_mode != "data"
+                or arch_cfg.get("edge_sharding")
+                or _halo_enabled(arch_cfg)
+            ):
                 raise ValueError(
                     f"Training.population.size={pop_n} cannot combine with "
-                    f"Architecture.parallelism={par_mode!r}/edge_sharding — the "
-                    "population member axis is the program's batch parallelism"
+                    f"Architecture.parallelism={par_mode!r}/edge_sharding/halo "
+                    "— the population member axis is the program's batch "
+                    "parallelism"
                 )
             if world > 1:
                 # each process would train its own unsynchronized population on
@@ -306,6 +313,31 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
                 f"Architecture.parallelism {par_mode!r} not one of "
                 "'data', 'tensor', 'pipeline'"
             )
+        # halo-exchange partitioning (parallel/halo.py) — the node-resident
+        # large-graph route. Validated BEFORE any mesh work so an impossible
+        # combination fails loudly instead of downgrading in the except below.
+        from .parallel.halo import halo_config, halo_enabled
+
+        halo_mode = halo_enabled(arch_cfg)
+        halo_cfg = halo_config(arch_cfg) if halo_mode else None
+        if halo_mode:
+            if arch_cfg.get("edge_sharding"):
+                raise ValueError(
+                    "Architecture.halo.enabled and Architecture.edge_sharding "
+                    "are mutually exclusive large-graph routes; pick one"
+                )
+            if par_mode != "data":
+                raise ValueError(
+                    "halo partitioning splits the graph over the DATA axis; "
+                    f"Architecture.parallelism={par_mode!r} cannot combine "
+                    "with it"
+                )
+            if _fsdp_requested and _fsdp_strategy != "NO_SHARD":
+                raise ValueError(
+                    "halo partitioning keeps params replicated inside its "
+                    "shard_map step; HYDRAGNN_USE_FSDP param sharding is not "
+                    "supported with it"
+                )
         mesh = None
         # how TrainState leaves are placed on the mesh — the elastic recovery
         # path re-places the restored state with the same policy after a re-mesh
@@ -315,13 +347,13 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
 
             n_dev = len(jax.devices())  # global (all processes)
             n_local = len(jax.local_devices())
-            # edge-sharded (long-context) mode feeds ONE batch to the whole mesh,
-            # so any loader length works
+            # edge-sharded / halo (long-context) modes feed ONE batch to the
+            # whole mesh, so any loader length works
             edge_mode = bool(arch_cfg.get("edge_sharding"))
             if (
                 flags.get(flags.AUTO_PARALLEL)
                 and n_dev > 1
-                and (edge_mode or len(train_loader) >= n_local)
+                and (edge_mode or halo_mode or len(train_loader) >= n_local)
             ):
                 from .parallel import make_mesh, shard_state
 
@@ -380,14 +412,21 @@ def run_training(config_source, samples: Sequence | None = None, rank: int = 0, 
 
                 if par_mode != "pipeline":
                     set_global_mesh(mesh)
-            elif par_mode != "data":
+            elif par_mode != "data" or (
+                halo_mode and halo_cfg.fallback == "error"
+            ):
                 raise ValueError(
-                    f"Architecture.parallelism={par_mode!r} requested but no "
-                    f"multi-device mesh is available ({n_dev} device(s), "
-                    f"{len(train_loader)} train batches)"
+                    f"Architecture.parallelism={par_mode!r}"
+                    + ("/halo" if halo_mode else "")
+                    + " requested but no multi-device mesh is available "
+                    f"({n_dev} device(s), {len(train_loader)} train batches)"
                 )
         except Exception as e:
-            if flags.get(flags.USE_FSDP) or par_mode != "data":
+            if (
+                flags.get(flags.USE_FSDP)
+                or par_mode != "data"
+                or (halo_mode and halo_cfg.fallback == "error")
+            ):
                 raise  # explicit sharding request: fail fast, don't downgrade
             print_distributed(verbosity, f"auto-parallel disabled ({e})")
             mesh = None

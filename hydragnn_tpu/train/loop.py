@@ -630,13 +630,57 @@ def train_validate_test(
     num_epoch = int(training["num_epoch"])
     precision = resolve_training_precision(training)
     loss_scale = resolve_loss_scale(training)
-    edge_sharded = bool(config_nn.get("Architecture", {}).get("edge_sharding"))
+    arch_cfg = config_nn.get("Architecture", {})
+    edge_sharded = bool(arch_cfg.get("edge_sharding"))
     res = resilience if resilience is not None else Resilience.from_config(training)
+
+    # halo-exchange route (parallel/halo.py): resolve BEFORE the dispatch
+    # chain so an unsupported model can fall back to plain data parallelism
+    # (halo.fallback: "data") instead of dying mid-chain
+    halo_on = False
+    halo_cfg = None
+    if mesh is not None and "data" in mesh.axis_names:
+        from ..parallel.halo import halo_config, halo_enabled, validate_halo_support
+
+        if halo_enabled(arch_cfg):
+            halo_cfg = halo_config(arch_cfg)
+            try:
+                validate_halo_support(model.spec)
+                halo_on = True
+            except ValueError as e:
+                if halo_cfg.fallback != "data":
+                    raise
+                print_distributed(
+                    verbosity,
+                    f"halo partitioning falling back to data parallel: {e}",
+                )
 
     put_fn = None
     group_n = None
     group_put = None
-    if mesh is not None and edge_sharded:
+    if mesh is not None and halo_on:
+        # node-resident giant-graph mode: ONE spatially partitioned batch per
+        # step; each device keeps its owned nodes/edges and refreshes only
+        # boundary halo rows via ppermute before each conv layer
+        from functools import partial as _partial
+
+        from ..parallel.halo import (
+            make_halo_eval_step,
+            make_halo_train_step,
+            put_halo_batch,
+        )
+
+        train_step = make_halo_train_step(
+            model, optimizer, mesh, compute_dtype=precision
+        )
+        eval_step = make_halo_eval_step(model, mesh, compute_dtype=precision)
+        put_fn = _partial(
+            put_halo_batch,
+            mesh=mesh,
+            cfg=halo_cfg,
+            cutoff=arch_cfg.get("radius"),
+        )
+    elif mesh is not None and edge_sharded:
         # long-context mode: every batch's EDGE arrays shard across the mesh,
         # nodes replicated; one (possibly giant) batch per step
         from functools import partial as _partial
@@ -711,14 +755,16 @@ def train_validate_test(
             model, optimizer, compute_dtype=precision, loss_scale=loss_scale
         )
         eval_step = make_eval_step(model, compute_dtype=precision)
-    if loss_scale is not None and mesh is not None and edge_sharded:
+    if loss_scale is not None and mesh is not None and (edge_sharded or halo_on):
         # the scaling hook is wired into the single-device, mesh, MLIP and
-        # pipeline step factories; edge-sharded long-context mode is the one
-        # remaining gap — say so instead of silently training unscaled fp16
+        # pipeline step factories; the edge-sharded and halo long-context
+        # modes are the remaining gaps — say so instead of silently training
+        # unscaled fp16
         print_distributed(
             verbosity,
             f"Training.loss_scale={loss_scale} is not wired into the "
-            "edge-sharded train step; this mode trains UNSCALED",
+            f"{'halo' if halo_on else 'edge-sharded'} train step; this mode "
+            "trains UNSCALED",
         )
 
     # Non-finite step guard (resilience/guard.py): wrap the train step —

@@ -121,6 +121,13 @@ EPOCH = _register(Flag(
 AUTO_PARALLEL = _register(Flag(
     "HYDRAGNN_AUTO_PARALLEL", "bool", True,
     "Auto-build a data mesh over all local devices in run_training."))
+HALO = _register(Flag(
+    "HYDRAGNN_HALO", "bool", None,
+    "Force halo-exchange graph partitioning on/off (overrides "
+    "Architecture.halo.enabled). Partitions ONE giant graph's nodes over "
+    "the data mesh in Morton order and exchanges only boundary node "
+    "features via ppermute before each conv layer (parallel/halo.py) — "
+    "the node-resident alternative to replicated edge_sharding."))
 USE_FSDP = _register(Flag(
     "HYDRAGNN_USE_FSDP", "bool", False,
     "Shard params+optimizer over the data axis, ZeRO-3 style (reference "

@@ -1,0 +1,99 @@
+"""Sanctioned halo-exchange partitioning patterns
+(hydragnn_tpu/graphs/partition.py, parallel/halo.py).
+
+The halo route splits ONE giant graph's nodes over the data mesh and
+refreshes only boundary rows between conv layers. Its shape must stay
+silent under every GL rule:
+
+- the partition + exchange plan is built HOST-SIDE in numpy at collate
+  time (Morton binning, boundary sets, bucket-padded slot lists): pure
+  functions of the frame, nothing jit-reachable, no ``jnp`` on the host
+  path (GL001/GL002 have no surface);
+- the partitioned step is built ONCE outside the epoch loop and reused
+  across frames — the plan's index lists ride the program as DATA, only
+  bucket widths are baked, so steady-state dispatch never re-traces
+  (GL003/GL004 stay quiet);
+- inside the device function the ring walks a STATIC python list of
+  (send, recv) index pairs — unrolled at trace time, statically skipping
+  empty shifts — and scatters with ``.at[].set``, never host mutation of
+  traced values;
+- the host-side plan cache is one dict behind one lock with a
+  ``# guarded-by:`` declaration (GL101), lookups hand back the IMMUTABLE
+  plan tuple, never an alias of the guarded dict (GL107), and no second
+  lock exists to order against (GL102);
+- cache stamps use a monotonic counter field, not wall-clock deadline
+  arithmetic (GL105), and nothing here spawns threads (GL106).
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def clean_boundary_rows(senders, receivers, owner):
+    """Host-side numpy boundary extraction: pure function of the frame."""
+    senders = np.asarray(senders)
+    receivers = np.asarray(receivers)
+    owner = np.asarray(owner)
+    cross = owner[senders] != owner[receivers]
+    return np.unique(senders[cross])
+
+
+def clean_slot_pad(ids, multiple):
+    """Bucket-pad a slot list so widths are shape-stable across frames."""
+    width = -(-max(len(ids), 1) // multiple) * multiple
+    out = np.zeros(width, np.int32)
+    out[: len(ids)] = ids
+    return out
+
+
+class CleanPlanCache:
+    """Frame-keyed plan cache: one lock, immutable values out."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plans = {}  # guarded-by: _lock
+        self._hits = 0  # guarded-by: _lock
+
+    def get(self, key, build):
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None:
+                self._hits += 1
+                return plan  # an immutable tuple, not the guarded dict
+        built = tuple(build())  # build outside the lock: no nesting
+        with self._lock:
+            return self._plans.setdefault(key, built)
+
+
+def clean_make_refresh(plan_pairs, n_dev, axis):
+    """Ring refresh over a STATIC pair list: empty shifts drop out of the
+    program at trace time; scatters stay functional."""
+
+    def refresh(h):
+        for i, (snd, rcv) in enumerate(plan_pairs):
+            if snd.shape[0] == 0:
+                continue  # statically empty shift: no collective emitted
+            shift = i + 1
+            perm = [(d, (d + shift) % n_dev) for d in range(n_dev)]
+            h = h.at[rcv].set(jax.lax.ppermute(h[snd], axis, perm))
+        return h
+
+    return refresh
+
+
+def clean_build_step(refresh):
+    """The step is jitted ONCE; frames flow through as arguments."""
+
+    @jax.jit
+    def step(x):
+        x = refresh(x)
+        return jnp.tanh(x)
+
+    return step
+
+
+def clean_epoch(step, frames):
+    # reuse the prebuilt executable per frame: no jit-in-loop, no retrace
+    return [step(f) for f in frames]

@@ -250,6 +250,18 @@ def test_screen_planner_shape_is_clean():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_halo_exchange_shape_is_clean():
+    """The halo-exchange partitioning shape (hydragnn_tpu/graphs/
+    partition.py, parallel/halo.py: host-numpy Morton partitioning and
+    boundary-set extraction, bucket-padded static slot lists riding the
+    program as data, a once-built shard_map step whose ring walks a static
+    pair list with functional scatters, a single-lock plan cache handing
+    out immutable tuples) is sanctioned: every rule — GL001-GL004 and
+    GL101/GL102/GL105/GL107 above all — must stay silent on it."""
+    findings = analyze([str(FIXTURES / "halo_exchange_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_gl003_scan_folded_steps_are_clean():
     """lax.scan-folded supersteps (train/superstep.py's pattern: one jitted
     scan built outside the loop, dispatched per block) are the sanctioned

@@ -1,0 +1,166 @@
+"""Spatial partitioning helpers (graphs/partition): Morton-ordered cell
+assignment, count-balanced contiguous splits, and boundary-set extraction —
+the host-side machinery the halo-exchange route builds its static plans from."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.graphs.partition import (
+    bounding_cell,
+    boundary_sets,
+    cell_assignment,
+    morton_codes,
+    partition_nodes,
+)
+
+
+def test_morton_known_values():
+    # code = interleave(x, y, z) with x highest: (x, y, z) on a 2^3 grid
+    # reduces to 4x + 2y + z
+    idx = np.array(
+        [[0, 0, 0], [0, 0, 1], [0, 1, 0], [1, 0, 0], [1, 1, 1]], np.int64
+    )
+    np.testing.assert_array_equal(morton_codes(idx), [0, 1, 2, 4, 7])
+    # bit interleaving beyond one bit per axis: x=2 -> bit 1 spreads to bit 3,
+    # shifted left 2 for the x lane
+    assert int(morton_codes(np.array([[2, 0, 0]]))[0]) == 32
+    assert int(morton_codes(np.array([[3, 3, 3]]))[0]) == 63
+
+
+def test_morton_locality_order():
+    # walking a 2x2x2 grid in code order visits each octant before jumping —
+    # consecutive codes differ in at most the low bits (compact bricks)
+    g = np.array([[x, y, z] for x in range(2) for y in range(2) for z in range(2)])
+    codes = morton_codes(g)
+    order = np.argsort(codes)
+    walked = g[order]
+    # first four visited cells all share x=0 (one spatial half), last four x=1
+    assert set(map(tuple, walked[:4])) == {(0, 0, 0), (0, 0, 1), (0, 1, 0), (0, 1, 1)}
+    assert (walked[4:, 0] == 1).all()
+
+
+def test_morton_range_errors():
+    with pytest.raises(ValueError):
+        morton_codes(np.array([[-1, 0, 0]]))
+    with pytest.raises(ValueError):
+        morton_codes(np.array([[1 << 21, 0, 0]]))
+
+
+def test_cell_assignment_formula():
+    cell = np.diag([10.0, 10.0, 10.0])
+    grid = (5, 5, 5)
+    pos = np.array(
+        [
+            [0.0, 0.0, 0.0],  # first cell
+            [1.9, 2.1, 9.9],  # floors of frac * grid
+            [10.5, 0.0, 0.0],  # periodic: wraps to 0.5 -> cell 0
+            [-0.5, 0.0, 0.0],  # periodic: wraps to 9.5 -> cell 4
+        ]
+    )
+    idx3, cid = cell_assignment(pos, grid, cell)
+    np.testing.assert_array_equal(
+        idx3, [[0, 0, 0], [0, 1, 4], [0, 0, 0], [4, 0, 0]]
+    )
+    # flat id matches (ix * gy + iy) * gz + iz
+    np.testing.assert_array_equal(cid, [0, 9, 0, 100])
+
+
+def test_cell_assignment_open_axes_clamp():
+    cell = np.diag([10.0, 10.0, 10.0])
+    pos = np.array([[-3.0, 10.0, 11.0]])
+    idx3, _ = cell_assignment(pos, (5, 5, 5), cell, pbc=[False] * 3)
+    # below the box clamps into the first cell; at/above the max corner into
+    # the LAST cell, never one past it
+    np.testing.assert_array_equal(idx3, [[0, 4, 4]])
+
+
+def test_cell_assignment_grid_error():
+    with pytest.raises(ValueError):
+        cell_assignment(np.zeros((1, 3)), (0, 1, 1), np.eye(3))
+
+
+def test_cell_assignment_origin_shift():
+    cell = np.diag([4.0, 4.0, 4.0])
+    pos = np.array([[102.0, 101.0, 103.9]])
+    idx3, _ = cell_assignment(
+        pos, (4, 4, 4), cell, pbc=[False] * 3, origin=np.array([100.0] * 3)
+    )
+    np.testing.assert_array_equal(idx3, [[2, 1, 3]])
+
+
+def test_bounding_cell_covers_all_atoms():
+    rng = np.random.default_rng(3)
+    pos = rng.uniform(-5, 17, size=(64, 3))
+    cell = bounding_cell(pos)
+    # binning against the bounding cell keeps every atom inside the grid
+    idx3, _ = cell_assignment(
+        pos, (4, 4, 4), cell, pbc=[False] * 3, origin=pos.min(axis=0)
+    )
+    assert (idx3 >= 0).all() and (idx3 <= 3).all()
+
+
+def test_partition_nodes_balance_and_determinism():
+    rng = np.random.default_rng(11)
+    pos = rng.uniform(0, 12.0, size=(403, 3))
+    p1 = partition_nodes(pos, 8, cutoff=2.5)
+    p2 = partition_nodes(pos, 8, cutoff=2.5)
+    np.testing.assert_array_equal(p1.order, p2.order)
+    np.testing.assert_array_equal(p1.owner, p2.owner)
+    np.testing.assert_array_equal(p1.start, p2.start)
+    assert p1.n_parts == 8
+    sizes = np.diff(p1.start)
+    assert sizes.sum() == 403 and sizes.max() - sizes.min() <= 1
+    # order / owner / start agree: part(p) is exactly owner == p
+    for p in range(8):
+        ids = p1.part(p)
+        assert len(ids) == sizes[p]
+        assert (p1.owner[ids] == p).all()
+    # order is a permutation of all nodes
+    assert len(np.unique(p1.order)) == 403
+
+
+def test_partition_nodes_morton_contiguity():
+    """Owned ranges are contiguous in the Morton walk: each partition's cells
+    form a compact rank range, not an interleaved scatter."""
+    rng = np.random.default_rng(5)
+    pos = rng.uniform(0, 8.0, size=(256, 3))
+    plan = partition_nodes(pos, 4, cutoff=2.0)
+    idx3, _ = cell_assignment(
+        pos, plan.grid, bounding_cell(pos), pbc=[False] * 3, origin=pos.min(axis=0)
+    )
+    codes = morton_codes(idx3)
+    walked = codes[plan.order]
+    assert (np.diff(walked.astype(np.float64)) >= 0).all()
+
+
+def test_partition_nodes_errors():
+    pos = np.zeros((3, 3))
+    with pytest.raises(ValueError):
+        partition_nodes(pos, 0)
+    with pytest.raises(ValueError):
+        partition_nodes(pos, 4)  # more partitions than nodes
+
+
+def test_boundary_sets_match_bruteforce():
+    rng = np.random.default_rng(17)
+    pos = rng.uniform(0, 10.0, size=(200, 3))
+    plan = partition_nodes(pos, 4, cutoff=3.0)
+    # random directed edges
+    senders = rng.integers(0, 200, 600)
+    receivers = rng.integers(0, 200, 600)
+    got = boundary_sets(senders, receivers, plan.owner, 4)
+
+    want: dict = {}
+    for s, r in zip(senders, receivers):
+        ps, pr = int(plan.owner[s]), int(plan.owner[r])
+        if ps != pr:
+            want.setdefault((ps, pr), set()).add(int(s))
+    assert set(got) == set(want)
+    for pair, ids in got.items():
+        np.testing.assert_array_equal(ids, sorted(want[pair]))
+        assert ids.dtype == np.int32
+
+
+def test_boundary_sets_no_crossings():
+    owner = np.zeros(10, np.int64)  # everything on one partition
+    assert boundary_sets(np.arange(9), np.arange(1, 10), owner, 4) == {}
