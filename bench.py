@@ -2698,6 +2698,269 @@ def bench_quant_serving_ab(n_requests: int = 64) -> dict:
     return rec
 
 
+def bench_replica_boot_ab(batch_size: int = 16, windows: int = 4) -> dict:
+    """Serialized-AOT replica boot A/B (ISSUE 20): warm the SAME endpoint
+    from the artifact store (deserialize exported StableHLO + XLA compile)
+    vs from source (trace + lower + export + re-persist + compile), paired
+    ABBA windows over full ``warmup(verify=True)`` calls. In-process on
+    purpose: both arms share one interpreter and jax's persistent XLA
+    cache, so the headline isolates exactly the boot work that differs —
+    tracing/lowering/export vs deserialize (the subprocess twin with cold
+    imports and wall-clock boot lives in ``tests/test_fleet.py``).
+    Per-arm evidence rides along: the serialized arm's per-bucket warm
+    report says ``loaded`` for every bucket (a single fallback would say
+    ``saved`` and re-write the store), one probe served by each arm's
+    executables is bit-identical, and per-arm steady lowerings after boot
+    are 0."""
+    import shutil
+    import tempfile
+
+    from hydragnn_tpu.analysis.sentinel import compile_counts
+    from hydragnn_tpu.serve import PredictionServer, ServingConfig
+
+    cfg, model, state, samples = _fleet_model_ingredients(batch_size, seed=59)
+    artifact_dir = tempfile.mkdtemp(prefix="bench-replica-boot-")
+    probe = samples[0]
+
+    def boot(arm_dir):
+        """One full boot: returns (warmup_s, warm_report, probe_heads,
+        steady_lowerings). Only warmup() is timed; the probe + lowering
+        audit run untimed on the freshly booted server."""
+        srv = PredictionServer(ServingConfig(flush_ms=3.0))
+        srv.add_model("m", model, state, cfg, samples=samples,
+                      batch_size=batch_size, artifact_dir=arm_dir)
+        t0 = time.perf_counter()
+        report = srv.warmup(verify=True)
+        elapsed = time.perf_counter() - t0
+        srv.start()
+        try:
+            before = int(compile_counts()["lowerings"])
+            heads = [
+                np.asarray(a)
+                for a in srv.submit("m", probe).result(timeout=120)["heads"]
+            ]
+            steady = int(compile_counts()["lowerings"]) - before
+        finally:
+            srv.stop()
+        return elapsed, report["m"], heads, steady
+
+    try:
+        # seed the artifact store once (the cold write every fleet pays
+        # exactly once); the serialized arm then measures pure loads
+        seed_s, seed_report, ref_heads, _ = boot(artifact_dir)
+        n_buckets = len(seed_report.get("serialized", {}))
+        a_ms, b_ms = [], []  # a = serialized boot, b = compile-from-source
+        loaded_ok, steady_max, parity = True, 0, True
+        for w in range(max(windows, 1)):
+            order = ("a", "b") if w % 2 == 0 else ("b", "a")
+            for arm in order:
+                elapsed, rep, heads, steady = boot(
+                    artifact_dir if arm == "a" else None
+                )
+                steady_max = max(steady_max, steady)
+                parity = parity and len(heads) == len(ref_heads) and all(
+                    np.array_equal(x, y) for x, y in zip(heads, ref_heads)
+                )
+                if arm == "a":
+                    a_ms.append(1e3 * elapsed)
+                    loaded_ok = loaded_ok and all(
+                        v == "loaded"
+                        for v in rep.get("serialized", {}).values()
+                    )
+                else:
+                    b_ms.append(1e3 * elapsed)
+    finally:
+        shutil.rmtree(artifact_dir, ignore_errors=True)
+    # overhead of source-vs-serialized: positive = serialized boots faster
+    overhead_pct, noise_pct, verdict = _abba_verdict(a_ms, b_ms,
+                                                     budget_pct=0.0)
+    med_a, med_b = statistics.median(a_ms), statistics.median(b_ms)
+    return {
+        "workload": "replica_boot_ab",
+        "batch_size": batch_size,
+        "n_buckets": n_buckets,
+        "cold_seed_boot_s": round(seed_s, 3),
+        "boot_ms_serialized": round(med_a, 1),
+        "boot_ms_from_source": round(med_b, 1),
+        "boot_ms_serialized_windows": [round(x, 1) for x in a_ms],
+        "boot_ms_from_source_windows": [round(x, 1) for x in b_ms],
+        "boot_speedup": round(med_b / med_a, 3) if med_a else None,
+        "source_overhead_pct": round(overhead_pct, 2),
+        "noise_pct": round(noise_pct, 2),
+        "abba_verdict": verdict,
+        # evidence columns: the serialized arm really loaded (never fell
+        # back), both arms answer bit-identically, and neither arm lowers
+        # anything after ready
+        "all_buckets_loaded": bool(loaded_ok),
+        "parity": bool(parity),
+        "steady_lowerings_max": int(steady_max),
+    }
+
+
+def bench_autoscale_slo_ab(batch_size: int = 16, n_requests: int = 150,
+                           service_delay_s: float = 0.05,
+                           windows: int = 2) -> dict:
+    """SLO-autoscaler recovery A/B (ISSUE 20): identical paced interactive
+    traffic against a 2-replica loopback fleet with a mid-stream replica
+    kill — autoscaler ON vs OFF. Each replica's replies are delayed by
+    ``service_delay_s`` with ``inflight_per_replica=1``, making per-replica
+    capacity exactly ``1/delay``; the arrival rate is pinned at 1.5x one
+    replica's capacity, so the healthy 2-replica fleet is stable and the
+    post-kill 1-replica fleet is overloaded by construction — the backlog
+    (and the interactive p99 with it) grows until capacity returns. The
+    OFF arm stays degraded to the end; the ON arm's control loop sees the
+    breach streak, spawns a replacement, and the final-quarter p99
+    recovers. Columns: pre-kill vs final-quarter p99 per arm, the ON arm's
+    kill-to-spawn latency from the autoscaler audit trail, and the
+    recovery ratio as the headline. CPU-provable: the physics is queueing,
+    not FLOPs."""
+    from hydragnn_tpu.serve import (
+        Autoscaler,
+        FleetRouter,
+        PredictionServer,
+        ReplicaHost,
+        ServingConfig,
+    )
+
+    cfg, model, state, samples = _fleet_model_ingredients(batch_size, seed=61)
+    srv = PredictionServer(ServingConfig(
+        flush_ms=2.0, queue_depth=max(512, n_requests)
+    ))
+    srv.add_model("m", model, state, cfg, samples=samples,
+                  batch_size=batch_size)
+    srv.warmup(verify=True)
+    srv.start()
+    interarrival_s = service_delay_s / 1.5
+    kill_at = n_requests // 3
+    target_p99_ms = 3e3 * service_delay_s
+
+    def _p99(xs):
+        if not xs:
+            return None
+        s = sorted(xs)
+        return round(s[min(len(s) - 1, int(0.99 * len(s)))], 1)
+
+    def arm(autoscale: bool) -> dict:
+        hosts = [ReplicaHost(srv), ReplicaHost(srv)]
+        for h in hosts:
+            h.set_delay(service_delay_s)
+        router = FleetRouter({
+            "peer_timeout": 10.0, "cache_bytes": 0,
+            "inflight_per_replica": 1,
+        })
+        for h in hosts:
+            router.attach("127.0.0.1", h.port)
+        router.start()
+        spawned: list = []
+
+        def spawn():
+            h = ReplicaHost(srv)
+            h.set_delay(service_delay_s)  # same service time as the fleet
+            spawned.append(h)
+            return h
+
+        scaler = None
+        if autoscale:
+            scaler = Autoscaler(router, {
+                "enabled": True, "interval_s": 0.25,
+                "target_p99_ms": target_p99_ms, "up_consecutive": 2,
+                "cooldown_s": 1.0, "max_replicas": 4,
+                # never scale down inside the measurement window
+                "down_consecutive": 10_000,
+            }, spawn_fn=spawn).start()
+        lock = threading.Lock()
+        done: list = []  # (request_no, t_done_rel, latency_ms)
+        shed = 0
+        t_kill = None
+        t_start = time.perf_counter()
+        try:
+            futs = []
+            for i in range(n_requests):
+                if i == kill_at:
+                    hosts[1].close()  # the drill: one replica drops dead
+                    t_kill = time.perf_counter() - t_start
+                t_sub = time.perf_counter()
+                try:
+                    fut = router.submit("m", samples[i % len(samples)],
+                                        priority="interactive")
+                except Exception:
+                    shed += 1
+                else:
+                    def _done(f, i=i, t_sub=t_sub):
+                        t = time.perf_counter()
+                        with lock:
+                            done.append(
+                                (i, t - t_start, 1e3 * (t - t_sub))
+                            )
+                    fut.add_done_callback(_done)
+                    futs.append(fut)
+                # paced open-loop arrivals: offered load does not slow
+                # down when the fleet degrades (that's the point)
+                time.sleep(max(0.0, (t_sub - t_start)
+                                + interarrival_s
+                                - (time.perf_counter() - t_start)))
+            for fut in futs:
+                try:
+                    fut.result(timeout=120)
+                except Exception:
+                    shed += 1
+        finally:
+            if scaler is not None:
+                scaler.stop()
+            router.stop()
+            for h in hosts + spawned:
+                h.close()
+        ok = sorted((i, t, ms) for i, t, ms in done)
+        pre = [ms for i, t, ms in ok if i < kill_at]
+        final = [ms for i, t, ms in ok if i >= 3 * n_requests // 4]
+        actions = []
+        if scaler is not None:
+            actions = [r for r in scaler.actions if r["action"] != "hold"]
+        return {
+            "p99_ms_pre_kill": _p99(pre),
+            "p99_ms_final_quarter": _p99(final),
+            "served": len(ok),
+            "shed": shed,
+            "kill_at_s": round(t_kill, 2) if t_kill is not None else None,
+            "replicas_spawned": len(spawned),
+            "autoscale_actions": actions[:6],
+        }
+
+    on_finals, off_finals = [], []
+    on_rec = off_rec = None
+    try:
+        for w in range(max(windows, 1)):
+            order = (False, True) if w % 2 == 0 else (True, False)
+            for auto in order:
+                rec = arm(auto)
+                if auto:
+                    on_rec = rec
+                    on_finals.append(rec["p99_ms_final_quarter"] or 0.0)
+                else:
+                    off_rec = rec
+                    off_finals.append(rec["p99_ms_final_quarter"] or 0.0)
+    finally:
+        srv.stop()
+    med_on = statistics.median(on_finals)
+    med_off = statistics.median(off_finals)
+    return {
+        "workload": "autoscale_slo_ab",
+        "batch_size": batch_size,
+        "n_requests": n_requests,
+        "service_delay_ms": round(1e3 * service_delay_s, 1),
+        "target_p99_ms": round(target_p99_ms, 1),
+        "kill_at_request": kill_at,
+        "p99_ms_final_autoscale_on": round(med_on, 1),
+        "p99_ms_final_autoscale_off": round(med_off, 1),
+        "p99_ms_final_on_windows": [round(x, 1) for x in on_finals],
+        "p99_ms_final_off_windows": [round(x, 1) for x in off_finals],
+        "slo_recovery_ratio": round(med_off / med_on, 2) if med_on else None,
+        "recovered": bool(med_on <= 2.0 * target_p99_ms),
+        "autoscale_on": on_rec,
+        "autoscale_off": off_rec,
+    }
+
+
 def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
                     k: int = 4) -> dict:
     """Degraded host-only row for dead-accelerator windows (the r3-r5
@@ -2752,6 +3015,11 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
     # accumulator) is analytic, and the parity/lowering gates run on a
     # forced 8-CPU-device child mesh, so the row is CPU-provable
     halo_exchange = _row(bench_halo_exchange_ab, 8, 2)
+    # ISSUE 20 rows: serialized-AOT boot vs compile-from-source and the
+    # SLO autoscaler's post-kill p99 recovery — both CPU-provable by
+    # construction (queueing physics + boot-path work, not FLOPs)
+    replica_boot = _row(bench_replica_boot_ab, 16, 2)
+    autoscale_slo = _row(bench_autoscale_slo_ab, 16, 120)
     return {
         "workload": "cpu_smoke",
         "degraded": True,
@@ -2775,6 +3043,8 @@ def bench_cpu_smoke(batch_size: int = 64, steps: int = 10, warmup: int = 2,
         "screen_throughput_ab": screen_throughput,
         "trace_propagation_ab": trace_propagation,
         "halo_exchange_ab": halo_exchange,
+        "replica_boot_ab": replica_boot,
+        "autoscale_slo_ab": autoscale_slo,
     }
 
 
@@ -3611,6 +3881,13 @@ def child_main(status_path: str) -> None:
     # CPU-provable by construction
     plan.append(("halo_exchange_ab",
                  lambda: bench_halo_exchange_ab()))
+    # ISSUE 20 acceptance rows: serialized-AOT replica boot vs
+    # compile-from-source (ABBA over full warmup(verify=True) boots,
+    # all-buckets-loaded + parity + 0 steady lowerings per arm) and the
+    # SLO autoscaler's interactive p99 recovery after a mid-stream replica
+    # kill, control loop on vs off — both CPU-provable by construction
+    plan.append(("replica_boot_ab", lambda: bench_replica_boot_ab()))
+    plan.append(("autoscale_slo_ab", lambda: bench_autoscale_slo_ab()))
     if os.getenv("BENCH_FUSED_AUTOTUNE", "1") != "0":
         # cheap kernel-only sweep BEFORE the compile-heavy arch entries, so
         # a short window still yields the tuning data it was added for

@@ -181,7 +181,13 @@ def update_config(config: dict, train_samples, val_samples=None, test_samples=No
     # one implementation); unknown keys survive this back-fill untouched
     # and raise there
     for key, val in fleet_config_defaults().items():
-        fleet_cfg.setdefault(key, val)
+        filled = fleet_cfg.setdefault(key, val)
+        # one level deeper for the control-plane sub-blocks
+        # (Serving.fleet.autoscale / Serving.fleet.rollout): a partial
+        # sub-block keeps the caller's keys and gains the rest
+        if isinstance(val, dict) and isinstance(filled, dict) and filled is not val:
+            for sub_key, sub_val in val.items():
+                filled.setdefault(sub_key, sub_val)
     for key, val in serving_defaults.items():
         serving_cfg.setdefault(key, val)
     # one range-check implementation; also validates the fleet block

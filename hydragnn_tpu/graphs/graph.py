@@ -247,6 +247,36 @@ jax.tree_util.register_pytree_with_keys(
 )
 
 
+# ``jax.export`` serialization (serialized-AOT replica boot) must carry the
+# treedef across processes, and the custom registration above makes
+# GraphBatch NOT a plain namedtuple node: 23 data children + ``meta`` as
+# static auxdata. Register the matching auxdata codec here, next to the
+# flattening it mirrors — BatchMeta is JSON-plain (bools/ints/None) by
+# construction, so a round trip reconstructs the exact treedef and ``jit``
+# keys traces identically on both sides of the boot.
+def _export_serialization() -> None:
+    import json as _json
+
+    from jax import export as _export
+
+    def _ser_meta(meta):
+        return _json.dumps(None if meta is None else list(meta)).encode()
+
+    def _deser_meta(blob):
+        payload = _json.loads(blob.decode())
+        return None if payload is None else BatchMeta(*payload)
+
+    _export.register_pytree_node_serialization(
+        GraphBatch,
+        serialized_name=f"{GraphBatch.__module__}.GraphBatch",
+        serialize_auxdata=_ser_meta,
+        deserialize_auxdata=_deser_meta,
+    )
+
+
+_export_serialization()
+
+
 class GraphSample:
     """One host-side (numpy, unpadded) graph sample — the analog of PyG ``Data``.
 

@@ -282,15 +282,276 @@ def run_campaign(seeds, run_schedule, **schedule_kw) -> dict:
     return report
 
 
+# -- serving-fleet campaign ---------------------------------------------------
+#
+# The training campaign above proves the COMPUTE plane degrades gracefully;
+# the fleet campaign proves the SERVING plane does: seeded schedules over the
+# chaos fleet vocabulary (replica_kill / replica_slow / rollout_during_load)
+# are fired at request coordinates into a live Zipf + mixed-priority replay
+# (serve.traffic), and the gate checks what self-healing actually MEANS:
+# zero lost requests, bounded service gaps (SLO recovery), bit-identical
+# answers for every duplicate graph across kills AND the blue/green cutover,
+# and no leaked threads or replica subprocesses. Serve imports stay lazy —
+# this module must stay importable from training-only contexts.
+
+#: the serving-fleet fault draw set (chaos.FLEET_FAULTS, re-exported here as
+#: the campaign vocabulary so schedule call sites read uniformly)
+FLEET_VOCAB = ("replica_kill", "replica_slow", "rollout_during_load")
+
+
+def random_fleet_schedule(
+    seed: int,
+    *,
+    n_requests: int,
+    n_replicas: int,
+    kinds=FLEET_VOCAB,
+    max_faults: int = 2,
+) -> list[dict]:
+    """One seeded fleet-fault schedule at request coordinates (``epoch`` 0,
+    ``dispatch`` = request index — see ``FaultPlan.on_request``). Placement
+    constraints keep every schedule survivable and meaningful: at most
+    ``n_replicas - 1`` kills (a survivor must exist to drain the queue),
+    kills land mid-stream (a kill at request 0 is just a smaller fleet, at
+    the last request it drills nothing), and at most one rollout per
+    schedule, landing in the middle third so requests genuinely straddle
+    the cutover. Deterministic per ``(seed, kwargs)``."""
+    if n_requests < 3:
+        raise ValueError(f"n_requests must be >= 3, got {n_requests}")
+    rng = np.random.default_rng(seed)
+    kinds = [k for k in kinds]
+    if n_replicas <= 1:
+        kinds = [k for k in kinds if k != "replica_kill"]
+    if not kinds:
+        raise ValueError("fleet fault vocabulary is empty under the constraints")
+    n_faults = int(rng.integers(1, max(2, max_faults + 1)))
+    kill_budget = max(0, n_replicas - 1)
+    rollout_used = False
+    events: list[dict] = []
+    for _ in range(n_faults):
+        kind = kinds[int(rng.integers(len(kinds)))]
+        if kind == "replica_kill" and kill_budget <= 0:
+            kind = "replica_slow"
+        if kind == "rollout_during_load" and rollout_used:
+            kind = "replica_slow"
+        ev: dict = {"fault": kind}
+        if kind == "replica_kill":
+            ev["dispatch"] = int(
+                rng.integers(n_requests // 4, max(n_requests // 4 + 1,
+                                                  3 * n_requests // 4))
+            )
+            ev["peer"] = int(rng.integers(0, n_replicas))
+            kill_budget -= 1
+        elif kind == "replica_slow":
+            ev["dispatch"] = int(rng.integers(0, n_requests))
+            ev["peer"] = int(rng.integers(0, n_replicas))
+            ev["seconds"] = round(float(rng.uniform(0.2, 0.6)), 3)
+        else:  # rollout_during_load: mid-stream, so traffic straddles it
+            ev["dispatch"] = int(
+                rng.integers(n_requests // 3, max(n_requests // 3 + 1,
+                                                  2 * n_requests // 3))
+            )
+            rollout_used = True
+        events.append(ev)
+    events.sort(key=lambda e: (e.get("dispatch") or 0, e["fault"]))
+    return events
+
+
+@dataclasses.dataclass
+class FleetOutcome:
+    """Everything the fleet invariant gate needs from one executed schedule.
+    ``answers`` maps sample index -> set of served-answer digests (one entry
+    per UNIQUE bit pattern: len > 1 means the same graph got different
+    answers somewhere — across a failover, or across the cutover);
+    ``lost`` counts requests that neither served nor shed typed;
+    ``max_service_gap_ms`` is the longest stretch with zero completions
+    (the observable SLO-recovery bound); ``leaked_procs`` counts replica
+    subprocesses still alive after teardown."""
+
+    seed: int
+    events: list
+    n_requests: int
+    served: int
+    shed: int
+    lost: int
+    answers: dict
+    max_service_gap_ms: float
+    lost_detail: list = dataclasses.field(default_factory=list)
+    recovery_budget_ms: float = 30_000.0
+    threads_before: int = 0
+    threads_after: int = 0
+    leaked_procs: int = 0
+
+
+def check_fleet_invariants(out: FleetOutcome) -> list[str]:
+    """The fleet campaign's acceptance gate: returns human-readable
+    violations (empty = the fleet self-healed through the schedule)."""
+    violations: list[str] = []
+    accounted = out.served + out.shed + out.lost
+    if accounted != out.n_requests:
+        violations.append(
+            f"seed {out.seed}: accounting hole — {accounted} outcomes for "
+            f"{out.n_requests} requests"
+        )
+    if out.lost:
+        detail = "; ".join(str(d) for d in out.lost_detail[:3])
+        violations.append(
+            f"seed {out.seed}: {out.lost} request(s) LOST (neither served "
+            f"nor shed typed): {detail or 'no detail'}"
+        )
+    split = {k: v for k, v in out.answers.items() if len(v) > 1}
+    if split:
+        violations.append(
+            f"seed {out.seed}: bit-identity broken — sample(s) "
+            f"{sorted(split)[:5]} served {max(len(v) for v in split.values())}"
+            " distinct answers across the run"
+        )
+    if out.max_service_gap_ms > out.recovery_budget_ms:
+        violations.append(
+            f"seed {out.seed}: {out.max_service_gap_ms:.0f} ms with zero "
+            f"completions (> {out.recovery_budget_ms:.0f} ms SLO-recovery "
+            "budget)"
+        )
+    if out.threads_after > out.threads_before:
+        violations.append(
+            f"seed {out.seed}: {out.threads_after - out.threads_before} "
+            "non-daemon thread(s) leaked"
+        )
+    if out.leaked_procs:
+        violations.append(
+            f"seed {out.seed}: {out.leaked_procs} replica subprocess(es) "
+            "still alive after teardown"
+        )
+    return violations
+
+
+def replay_traffic_with_faults(
+    router,
+    model: str,
+    samples,
+    n_requests: int,
+    *,
+    seed: int = 0,
+    plan=None,
+    actions: dict | None = None,
+    order=None,
+    priorities=None,
+    timeout_s: float = 120.0,
+) -> dict:
+    """Drive a Zipf-duplicate, mixed-priority request replay at ``router``,
+    firing ``plan``'s fleet faults at request coordinates via the bound
+    ``actions`` (see ``FaultPlan.on_request``). Run it against a router
+    with ``cache_bytes=0`` when the point is bit-identity: with the answer
+    cache on, a duplicate after the cutover could be served from a
+    pre-cutover answer and the cross-generation comparison proves nothing.
+
+    Returns the raw material for :class:`FleetOutcome`: ``served`` /
+    ``shed`` / ``lost`` counts, ``lost_detail``, ``answers`` (sample index
+    -> digest set over served heads), and ``max_service_gap_ms``."""
+    import hashlib
+    import time
+
+    from ..serve.admission import AdmissionError, QueueFullError
+    from ..serve.traffic import mixed_priority_plan, zipf_duplicate_order
+
+    if order is None:
+        order = zipf_duplicate_order(n_requests, len(samples), seed=seed)
+    if priorities is None:
+        priorities = mixed_priority_plan(n_requests, seed=seed)
+    done_times: list[float] = []  # appended from done-callbacks
+    futures: list[tuple[int, object]] = []
+    served = shed = 0
+    lost_detail: list[str] = []
+    answers: dict[int, set] = {}
+    t0 = time.monotonic()
+    for i in range(n_requests):
+        if plan is not None:
+            plan.on_request(i, actions or {})
+        sample = samples[int(order[i])]
+
+        def _submit():
+            fut = router.submit(model, sample, priority=priorities[i])
+            fut.add_done_callback(
+                lambda f: done_times.append(time.monotonic())
+            )
+            futures.append((int(order[i]), fut))
+
+        try:
+            _submit()
+        except QueueFullError:
+            time.sleep(0.002)  # run_traffic's retry-once-then-shed idiom
+            try:
+                _submit()
+            except QueueFullError:
+                shed += 1
+    for sample_idx, fut in futures:
+        try:
+            heads = [np.asarray(h) for h in fut.result(timeout_s)["heads"]]
+        except AdmissionError:
+            shed += 1  # typed shed (failover exhausted / deadline): counted
+            continue
+        except Exception as e:  # anything untyped or hung is a LOST request
+            lost_detail.append(f"sample {sample_idx}: {type(e).__name__}: {e}")
+            continue
+        served += 1
+        digest = hashlib.sha1()
+        for h in heads:
+            digest.update(repr((h.shape, str(h.dtype))).encode())
+            digest.update(np.ascontiguousarray(h).tobytes())
+        answers.setdefault(sample_idx, set()).add(digest.hexdigest())
+    gaps_ms = 0.0
+    marks = [t0] + sorted(done_times)
+    for a, b in zip(marks, marks[1:]):
+        gaps_ms = max(gaps_ms, (b - a) * 1e3)
+    return {
+        "served": served,
+        "shed": shed,
+        "lost": len(lost_detail),
+        "lost_detail": lost_detail,
+        "answers": answers,
+        "max_service_gap_ms": round(gaps_ms, 3),
+    }
+
+
+def run_fleet_campaign(seeds, run_schedule, **schedule_kw) -> dict:
+    """The fleet mirror of :func:`run_campaign`: one seeded fleet schedule
+    per seed, executed by the caller-supplied ``run_schedule(seed, events)
+    -> FleetOutcome`` (it owns the topology: replicas, router, fault
+    actions), gated by :func:`check_fleet_invariants`."""
+    report: dict = {"schedules": [], "violations": []}
+    for seed in seeds:
+        events = random_fleet_schedule(int(seed), **schedule_kw)
+        outcome = run_schedule(int(seed), [dict(e) for e in events])
+        violations = check_fleet_invariants(outcome)
+        report["schedules"].append(
+            {
+                "seed": int(seed),
+                "events": events,
+                "served": outcome.served,
+                "shed": outcome.shed,
+                "violations": violations,
+            }
+        )
+        report["violations"].extend(violations)
+    report["n_schedules"] = len(report["schedules"])
+    report["passed"] = not report["violations"]
+    return report
+
+
 __all__ = [
     "BENIGN_FAULTS",
     "DEFAULT_VOCAB",
+    "FLEET_VOCAB",
+    "FleetOutcome",
     "PERTURBING_FAULTS",
     "RECOVERY_FAULTS",
     "ScheduleOutcome",
+    "check_fleet_invariants",
     "check_invariants",
     "nondaemon_thread_count",
     "random_fault_schedule",
+    "random_fleet_schedule",
+    "replay_traffic_with_faults",
     "run_campaign",
+    "run_fleet_campaign",
     "split_plan",
 ]

@@ -17,7 +17,10 @@ Plan format — a JSON list of events (inline, or ``@/path/to/plan.json``)::
       {"fault": "slow_peer",  "epoch": 0, "dispatch": 2, "peer": 0, "seconds": 5},
       {"fault": "device_loss", "epoch": 1, "dispatch": 0, "device": 3},
       {"fault": "mesh_shrink", "epoch": 1, "dispatch": 1, "to": 2},
-      {"fault": "double_fault", "inner": {"fault": "device_loss"}}
+      {"fault": "double_fault", "inner": {"fault": "device_loss"}},
+      {"fault": "replica_kill", "dispatch": 40, "peer": 1},
+      {"fault": "replica_slow", "dispatch": 10, "peer": 0, "seconds": 0.4},
+      {"fault": "rollout_during_load", "dispatch": 60}
     ]'
 
 * ``nan_batch`` — multiply the batch's node features by NaN *after* device
@@ -54,6 +57,24 @@ Plan format — a JSON list of events (inline, or ``@/path/to/plan.json``)::
   underway, a nested sigterm re-drains the resumed segment, and the
   checkpoint sidecar records the logical grid exactly once either way.
 
+The SERVING-fleet vocabulary fires at request coordinates instead of
+training dispatches — the traffic driver calls :meth:`FaultPlan.on_request`
+before admitting request ``i``, which matches events at ``(epoch=0,
+dispatch=i)`` (fleet plans leave ``epoch`` at its default). The harness
+stays mechanism-free here: the driver binds each fault name to an action
+callable (kill THAT replica process, ``set_delay`` on THAT host, run the
+mid-load blue/green rollout), because only the driver owns the topology.
+
+* ``replica_kill`` — SIGKILL the ``peer``-th replica mid-traffic: the
+  router must quarantine it and fail its in-flight requests over with zero
+  lost requests.
+* ``replica_slow`` — delay the ``peer``-th replica's replies by
+  ``seconds``: the gray-failure drill at the serving tier (watchdog severs
+  the dribble, quarantine + failover take over).
+* ``rollout_during_load`` — run a full blue/green cutover while the
+  request stream is in flight: the compound drill proving upgrade and
+  fault-recovery compose.
+
 ``dispatch`` omitted/null matches every dispatch of the epoch; ``times``
 caps how often an event fires (default 1; -1 = unlimited).
 """
@@ -68,10 +89,14 @@ import sys
 import time
 from pathlib import Path
 
+# serving-fleet faults: fired by FaultPlan.on_request at request
+# coordinates (epoch 0), bound to actions by the traffic driver
+FLEET_FAULTS = ("replica_kill", "replica_slow", "rollout_during_load")
+
 _FAULTS = (
     "nan_batch", "sigterm", "hang", "corrupt_latest", "dead_shard",
     "slow_peer", "device_loss", "mesh_shrink", "double_fault",
-)
+) + FLEET_FAULTS
 
 # double_fault payloads fire while a recovery is ALREADY in flight, so the
 # nested fault must itself be something the controller can absorb mid-flight
@@ -221,6 +246,35 @@ class FaultPlan:
             out.append(dict(ev.inner or {"fault": "device_loss"}))
         return out
 
+    def on_request(self, request_no: int, actions: dict) -> list:
+        """Apply serving-fleet faults before request ``request_no`` is
+        admitted. Fleet plans address requests as ``(epoch=0, dispatch=
+        request_no)`` — the request stream is one "epoch" of dispatches.
+
+        ``actions`` binds fault names to callables taking the fired
+        :class:`FaultEvent` — the traffic driver owns the topology (which
+        subprocess to SIGKILL, which host to ``set_delay``, how to run the
+        mid-load rollout), so the plan stays pure schedule. A fault with no
+        bound action is an inert stderr note, mirroring
+        :func:`_live_server`'s out-of-range behavior. Returns the fired
+        events."""
+        fired = []
+        for fault in FLEET_FAULTS:
+            ev = self._take(fault, 0, request_no)
+            if ev is None:
+                continue
+            fn = actions.get(fault)
+            if fn is None:
+                print(
+                    f"[chaos] no action bound for {fault!r} at request "
+                    f"{request_no}; fault skipped",
+                    file=sys.stderr,
+                )
+                continue
+            fn(ev)
+            fired.append(ev)
+        return fired
+
     def on_epoch_end(self, epoch: int, log_name: str, path: str = "./logs/"):
         """Apply epoch-scoped faults (checkpoint corruption) after the
         epoch's checkpoints are written. Each matching event fires at most
@@ -293,4 +347,10 @@ def corrupt_checkpoint(ckpt_path: str) -> str:
     return str(target)
 
 
-__all__ = ["FaultEvent", "FaultPlan", "corrupt_checkpoint", "poison_batch"]
+__all__ = [
+    "FLEET_FAULTS",
+    "FaultEvent",
+    "FaultPlan",
+    "corrupt_checkpoint",
+    "poison_batch",
+]

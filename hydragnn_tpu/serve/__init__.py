@@ -22,10 +22,16 @@ from .admission import (  # noqa: F401
 from .batcher import MicroBatcher, canonical_meta, serving_collate  # noqa: F401
 from .fleet import (  # noqa: F401
     AnswerCache,
+    Autoscaler,
+    AutoscalerConfig,
+    CanaryMismatchError,
     FleetConfig,
     FleetRouter,
+    ReplicaBootError,
     ReplicaHost,
+    RolloutConfig,
     answer_key,
+    blue_green_rollout,
     fleet_config_defaults,
     spawn_replica,
 )
@@ -47,6 +53,9 @@ from .traffic import (  # noqa: F401
 __all__ = [
     "AdmissionError",
     "AnswerCache",
+    "Autoscaler",
+    "AutoscalerConfig",
+    "CanaryMismatchError",
     "DeadlineExceededError",
     "FleetConfig",
     "FleetRouter",
@@ -58,14 +67,17 @@ __all__ = [
     "Predictor",
     "QuantizationError",
     "QueueFullError",
+    "ReplicaBootError",
     "ReplicaHost",
     "Request",
     "RequestQueue",
+    "RolloutConfig",
     "ServerClosedError",
     "ServingConfig",
     "TrafficReport",
     "UnknownModelError",
     "answer_key",
+    "blue_green_rollout",
     "canonical_meta",
     "fleet_config_defaults",
     "mixed_priority_plan",

@@ -16,13 +16,26 @@ package's ``config`` submodule at module level, and an eager router
 import here would close an import cycle back into ``serve.server``.
 """
 
-from .config import FleetConfig, PRIORITY_CLASSES, fleet_config_defaults  # noqa: F401
+from .config import (  # noqa: F401
+    AutoscalerConfig,
+    FleetConfig,
+    PRIORITY_CLASSES,
+    RolloutConfig,
+    autoscaler_config_defaults,
+    fleet_config_defaults,
+    rollout_config_defaults,
+)
 
 _LAZY = {
     "AnswerCache": ".cache",
     "answer_key": ".cache",
     "canonical_sample_bytes": ".cache",
     "FleetRouter": ".router",
+    "Autoscaler": ".autoscaler",
+    "CanaryMismatchError": ".rollout",
+    "blue_green_rollout": ".rollout",
+    "run_canary": ".rollout",
+    "ReplicaBootError": ".replica",
     "ReplicaHost": ".replica",
     "ReplicaProcess": ".replica",
     "spawn_replica": ".replica",
@@ -32,14 +45,23 @@ _LAZY = {
 
 __all__ = [
     "AnswerCache",
+    "Autoscaler",
+    "AutoscalerConfig",
+    "CanaryMismatchError",
     "FleetConfig",
     "FleetRouter",
     "PRIORITY_CLASSES",
+    "ReplicaBootError",
     "ReplicaHost",
     "ReplicaProcess",
+    "RolloutConfig",
     "answer_key",
+    "autoscaler_config_defaults",
+    "blue_green_rollout",
     "canonical_sample_bytes",
     "fleet_config_defaults",
+    "rollout_config_defaults",
+    "run_canary",
     "spawn_replica",
     "worker_main",
     "write_samples_file",

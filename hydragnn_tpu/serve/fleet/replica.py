@@ -44,8 +44,17 @@ import numpy as np
 
 from ...utils import wire
 from ..admission import AdmissionError
+from .config import FleetConfig
 
 _PREDICT_TIMEOUT_S = 120.0
+
+
+class ReplicaBootError(RuntimeError):
+    """A worker's ready file existed but could not be trusted: torn or
+    garbage contents (writer killed mid-write, foreign file) or a payload
+    missing the boot contract's fields. Carries the path and the partial
+    contents so the operator sees WHAT was on disk, not an opaque
+    ``JSONDecodeError`` from deep inside the poll loop."""
 
 
 class ReplicaHost(wire.WireServer):
@@ -184,6 +193,13 @@ def _build_server(spec: dict):
 
     serving = dict(spec.get("serving") or {})
     server = PredictionServer(ServingConfig(**serving))
+    # serialized-AOT boot: honored only when the fleet block (or the
+    # HYDRAGNN_SERIALIZED_BOOT flag) says so — endpoints with an
+    # artifact_dir deserialize warm executables instead of recompiling,
+    # falling back loudly per bucket on a fingerprint mismatch
+    fleet_cfg = FleetConfig.from_config(
+        {"fleet": dict(serving.get("fleet") or {})}
+    )
     for m in spec["models"]:
         with open(m["samples_file"], "rb") as f:
             samples = wire.samples_from_frame(wire.unpack_arrays(f.read()))
@@ -192,6 +208,8 @@ def _build_server(spec: dict):
             for k in ("batch_size", "max_buckets", "denormalize", "epoch")
             if k in m
         }
+        if fleet_cfg.serialized_boot and m.get("artifact_dir"):
+            kwargs["artifact_dir"] = m["artifact_dir"]
         server.add_model_from_checkpoint(
             m["name"], m["log_name"], path=m.get("path", "./logs/"),
             samples=samples, **kwargs,
@@ -307,11 +325,48 @@ def write_samples_file(samples, path: str) -> str:
     return path
 
 
-def spawn_replica(spec: dict, timeout_s: float = 300.0,
+def _read_ready_file(path: str) -> dict:
+    """Parse a worker's ready file, typed-erroring on anything short of the
+    boot contract. ``_write_ready`` is atomic (tmp + ``os.replace``), so a
+    HEALTHY writer never leaves a torn file — but a writer killed mid-write,
+    a crashed filesystem, or a foreign file can. Those used to surface as an
+    opaque ``JSONDecodeError``; now they raise :class:`ReplicaBootError`
+    naming the path and the partial contents."""
+    try:
+        with open(path, errors="replace") as f:
+            raw = f.read()
+    except OSError as e:
+        raise ReplicaBootError(f"ready file {path} unreadable: {e!r}") from e
+    try:
+        ready = json.loads(raw)
+    except ValueError as e:
+        raise ReplicaBootError(
+            f"ready file {path} is torn/garbage (writer killed mid-write?): "
+            f"{e}; partial contents: {raw[:256]!r}"
+        ) from e
+    if not isinstance(ready, dict) or not (
+        "error" in ready or "port" in ready
+    ):
+        raise ReplicaBootError(
+            f"ready file {path} violates the boot contract (expected a dict "
+            f"with 'port' or 'error'): {raw[:256]!r}"
+        )
+    return ready
+
+
+def spawn_replica(spec: dict, timeout_s: float | None = None,
                   env: dict | None = None) -> ReplicaProcess:
     """Launch one worker subprocess and block until it advertises ready
     (which, per the boot contract, means AOT warm-up finished). Raises
-    with the worker's log tail on boot failure/timeout."""
+    with the worker's log tail on boot failure/timeout.
+
+    ``timeout_s=None`` (the default) takes ``Serving.fleet.boot_timeout_s``
+    from the spec's serving block — one knob for every boot site instead of
+    a hardcoded constant; pass an explicit value to override per call."""
+    if timeout_s is None:
+        timeout_s = FleetConfig.from_config(
+            {"fleet": dict((spec.get("serving") or {}).get("fleet") or {})}
+        ).boot_timeout_s
     workdir = tempfile.mkdtemp(prefix="hydragnn-fleet-")
     spec = dict(spec)
     spec.setdefault("ready_file", os.path.join(workdir, "ready.json"))
@@ -333,8 +388,11 @@ def spawn_replica(spec: dict, timeout_s: float = 300.0,
     deadline = time.monotonic() + float(timeout_s)
     while time.monotonic() < deadline:
         if os.path.exists(spec["ready_file"]):
-            with open(spec["ready_file"]) as f:
-                ready = json.load(f)
+            try:
+                ready = _read_ready_file(spec["ready_file"])
+            except ReplicaBootError:
+                handle.terminate()
+                raise
             if "error" in ready:
                 handle.terminate()
                 raise RuntimeError(
@@ -359,6 +417,7 @@ if __name__ == "__main__":
 
 
 __all__ = [
+    "ReplicaBootError",
     "ReplicaHost",
     "ReplicaProcess",
     "spawn_replica",

@@ -87,6 +87,13 @@ class _Replica:
     inflight: int = 0
     served: int = 0
     failures: int = 0
+    # drain/retire lifecycle (guarded-by: _work, like the mutable counters
+    # above): ``draining`` stops NEW dispatch while in-flight round-trips
+    # finish; ``retired`` removes the replica from every routing/metrics
+    # surface. Ranks stay stable — a retired replica keeps its list slot
+    # (callers hold ranks across scale events), it is just never picked.
+    draining: bool = False
+    retired: bool = False
 
 
 class FleetRouter:
@@ -113,7 +120,8 @@ class FleetRouter:
             self.cfg.peer_timeout, auth_token=self.cfg.auth
         )
         self._health = wire.HealthTable(
-            self.cfg.quarantine_base_s, self.cfg.quarantine_cap_s
+            self.cfg.quarantine_base_s, self.cfg.quarantine_cap_s,
+            jitter=self.cfg.quarantine_jitter,
         )
         self.cache = AnswerCache(self.cfg.cache_bytes)
         self._replicas: list[_Replica] = []  # guarded-by: _work
@@ -127,6 +135,12 @@ class FleetRouter:
             "cancelled": 0, "shed": 0, "shed_deadline": 0,
             "failovers": 0, "requeues": 0,
             **{f"shed_{c}": 0 for c in PRIORITY_CLASSES},
+        }
+        # per-class sliding latency windows (replica-served requests only —
+        # cache hits would flatter the tail the autoscaler watches);
+        # bounded deques, so stats() percentiles cost O(window) not O(traffic)
+        self._latency: dict[str, deque] = {  # guarded-by: _work
+            c: deque(maxlen=256) for c in PRIORITY_CLASSES
         }
         self._running = False
         self._stopping = False
@@ -165,7 +179,10 @@ class FleetRouter:
             # differ between modes, so both least-loaded dispatch and the
             # (quant-flag-keyed) answer cache would mix them — a precision-
             # heterogeneous fleet is a configuration error, refused here
+            # (retired generations don't constrain the new one)
             for r in self._replicas:
+                if r.retired:
+                    continue
                 for m in set(r.models) & set(names):
                     if r.quantized.get(m) != quantized.get(m):
                         raise RuntimeError(
@@ -184,7 +201,61 @@ class FleetRouter:
         return rank
 
     def _models_union(self) -> set:
-        return {m for r in self._replicas for m in r.models}
+        # draining replicas still count: their in-flight work finishes and,
+        # during a cutover, the green generation is attached BEFORE blue
+        # drains — so the served-model set never blinks empty
+        return {m for r in self._replicas if not r.retired for m in r.models}
+
+    def begin_drain(self, rank: int) -> None:
+        """Stop dispatching NEW work to ``rank``; in-flight round-trips
+        finish and resolve normally. Queued requests simply route to the
+        other replicas — nothing is dropped or re-ordered."""
+        with self._work:
+            self._replicas[rank].draining = True
+            self._work.notify_all()
+        tel.emit("fleet_drain_begin", replica=rank)
+
+    def retire(self, rank: int, timeout_s: float = 30.0) -> bool:
+        """Drain ``rank`` and remove it from every routing surface. Blocks
+        until its in-flight count hits zero (each decrement notifies
+        ``_work``) or ``timeout_s`` passes; either way the replica is
+        retired — on timeout its still-in-flight requests fail over through
+        the normal transport-fault path when the process dies, so the
+        zero-lost-requests property holds regardless. Returns True when the
+        drain completed cleanly inside the timeout."""
+        self.begin_drain(rank)
+        deadline = time.monotonic() + float(timeout_s)
+        with self._work:
+            r = self._replicas[rank]
+            while r.inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._work.wait(min(remaining, 0.1))
+            left = r.inflight
+            drained = left == 0
+            r.retired = True
+            self._work.notify_all()
+        self._health.lift(rank)  # no point probing a retired replica
+        self._rt.evict((r.host, r.port))
+        tel.emit("fleet_retire", replica=rank, drained=bool(drained))
+        if not drained:
+            warnings.warn(
+                f"fleet replica {rank} retired with {left} round-trips "
+                f"still in flight after {timeout_s}s drain; they resolve or "
+                "fail over on their own"
+            )
+        return drained
+
+    def active_ranks(self) -> list:
+        """Ranks currently eligible for new dispatch (not draining, not
+        retired) — the live set a rollout cuts over from and the replica
+        count the autoscaler budgets against."""
+        with self._work:
+            return [
+                r.rank for r in self._replicas
+                if not r.draining and not r.retired
+            ]
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -202,9 +273,16 @@ class FleetRouter:
             self._rt = wire.RoundTripper(
                 self.cfg.peer_timeout, auth_token=self.cfg.auth
             )
+        # headroom over the boot-time replica count: the autoscaler and
+        # blue/green rollouts ATTACH replicas while the router is live, and
+        # an executor sized exactly to the boot topology would serialize
+        # the new capacity's round-trips behind the old pool
         self._exec = ThreadPoolExecutor(
-            max_workers=max(1, len(self._replicas))
-            * int(self.cfg.inflight_per_replica),
+            max_workers=max(
+                16,
+                max(1, len(self._replicas))
+                * int(self.cfg.inflight_per_replica),
+            ),
             thread_name_prefix="fleet-send",
         )
         self._dispatcher = threading.Thread(
@@ -426,7 +504,8 @@ class FleetRouter:
         into the dead peer until its attempt cap killed it."""
         avail = [
             r for r in self._replicas
-            if model in r.models and r.inflight < self.cfg.inflight_per_replica
+            if model in r.models and not r.draining and not r.retired
+            and r.inflight < self.cfg.inflight_per_replica
         ]
         if not avail:
             return None
@@ -436,7 +515,8 @@ class FleetRouter:
         pool = [by_rank[k] for k in order if not self._health.quarantined(k)]
         if not pool:
             if any(
-                model in r.models and not self._health.quarantined(r.rank)
+                model in r.models and not r.draining and not r.retired
+                and not self._health.quarantined(r.rank)
                 for r in self._replicas
             ):
                 return None  # healthy-but-saturated exists: wait for it
@@ -575,8 +655,13 @@ class FleetRouter:
             return
         heads = [np.array(z[f"h{i}"]) for i in range(int(z["nheads"]))]
         self._health.lift(replica.rank)  # it answered: clear any suspicion
+        latency_s = time.monotonic() - req.enqueued_at
         with self._work:
             replica.served += 1
+            # the autoscaler's SLO signal: queue wait + round-trip, per
+            # class, recorded for every replica-served answer (even ones a
+            # racing cancel makes unclaimable — the latency was real)
+            self._latency[req.priority].append(latency_s)
         if req.digest is not None:
             # insert BEFORE resolving the future: a client that resubmits
             # the same graph the instant its result lands must find the
@@ -587,7 +672,6 @@ class FleetRouter:
         if not req.claim():
             self._count("cancelled")
             return
-        latency_s = time.monotonic() - req.enqueued_at
         if req.request_id is not None:
             tel.emit(
                 "fleet_reply", model=req.model, replica=replica.rank,
@@ -759,17 +843,34 @@ class FleetRouter:
         with self._work:
             c = dict(self.counters)
             depths = {cls: len(q) for cls, q in self._queues.items()}
+            latency = {
+                cls: (
+                    round(
+                        float(np.percentile(np.asarray(win), 99)) * 1e3, 3
+                    )
+                    if win else None
+                )
+                for cls, win in self._latency.items()
+            }
             replicas = [
                 {
                     "rank": r.rank, "host": r.host, "port": r.port,
                     "models": list(r.models), "inflight": r.inflight,
                     "served": r.served, "failures": r.failures,
                     "quarantined": self._health.quarantined(r.rank),
+                    "draining": r.draining, "retired": r.retired,
                 }
                 for r in self._replicas
             ]
+            active = sum(
+                1 for r in self._replicas if not r.draining and not r.retired
+            )
         c["queue_depths"] = depths
+        # p99 over the per-class sliding windows (replica-served requests;
+        # None = no traffic in the window yet) — the autoscaler's SLO input
+        c["latency_p99_ms"] = latency
         c["replicas"] = replicas
+        c["active_replicas"] = active
         c["cache"] = self.cache.stats()
         # registry mirror (counters dual-write at their increment sites)
         tel.publish("fleet", c)
@@ -803,15 +904,16 @@ class FleetRouter:
             "registry": tel.snapshot(),
             "replicas": {},
         }
+        live = [r for r in list(self._replicas) if not r.retired]
         agg = {
-            "replicas_total": len(self._replicas),
+            "replicas_total": len(live),
             "replicas_reporting": 0,
             "queue_depth": 0,
             "shed": 0,
             "served": 0,
             "steady_lowerings": 0,
         }
-        for r in list(self._replicas):
+        for r in live:
             if self._health.quarantined(r.rank):
                 out["replicas"][str(r.rank)] = {"error": "quarantined"}
                 continue

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 import threading
 import time
 from concurrent.futures import Future
@@ -207,13 +208,18 @@ class ModelEndpoint:
     def __init__(self, name: str, predictor: Predictor,
                  buckets: Sequence[PadSpec], example: GraphSample,
                  cfg: ServingConfig, denormalize: bool = False,
-                 calib_samples: Sequence[GraphSample] | None = None):
+                 calib_samples: Sequence[GraphSample] | None = None,
+                 artifact_dir: str | None = None):
         self.name = name
         self.predictor = predictor
         self.buckets = sorted(buckets, key=lambda p: p.as_tuple())
         self.example = example
         self.cfg = cfg
         self.denormalize = denormalize
+        # serialized-AOT artifact store (serve/fleet serialized boot): warm()
+        # loads per-bucket executables from here when fingerprints match and
+        # persists fresh ones when they don't; None = always compile
+        self.artifact_dir = artifact_dir
         self.executables: dict[tuple, object] = {}
         # int8 variants (cfg.quantize): one quantized executable per bucket,
         # compiled ALONGSIDE the fp32 table — never instead of it
@@ -296,11 +302,21 @@ class ModelEndpoint:
     def warm(self, verify: bool = True) -> dict:
         """AOT-lower + compile this endpoint's predict program once per
         bucket; optionally verify a dummy pass through every executable is
-        lowering-free (the strict-sentinel gate CI runs)."""
+        lowering-free (the strict-sentinel gate CI runs).
+
+        With an ``artifact_dir``, each bucket first tries the serialized-AOT
+        artifact store: a fingerprint-matched artifact deserializes in
+        seconds (the fast replica boot path); a missing/stale one logs a
+        LOUD per-bucket note, compiles from the exported StableHLO, and
+        persists a fresh artifact for the next boot. Both paths produce the
+        same program, so serialized boots answer bit-identically."""
         from ..analysis.sentinel import no_recompile
         from ..utils.compile_cache import (
+            ArtifactError,
             aot_compile,
             enable_compile_cache,
+            load_artifact,
+            save_artifact,
             shape_structs,
         )
 
@@ -309,19 +325,50 @@ class ModelEndpoint:
         enable_compile_cache()
         report = {}
         dummy = _dummy_sample(self.example)
+        if self.artifact_dir:
+            report["serialized"] = {}
         for pad in self.buckets:
             batch = serving_collate([dummy], pad)
             t0 = time.perf_counter()
-            self.executables[pad.as_tuple()] = aot_compile(
-                self.predictor.predict_step,
-                self.predictor.state,
-                shape_structs(batch),
-                ledger_entry={
-                    "model": self.name, "bucket": pad.as_tuple(),
-                    "kind": "predict",
-                    "precision": str(self.predictor.compute_dtype),
-                },
-            )
+            ledger_entry = {
+                "model": self.name, "bucket": pad.as_tuple(),
+                "kind": "predict",
+                "precision": str(self.predictor.compute_dtype),
+            }
+            if self.artifact_dir:
+                key = dict(
+                    model=self.name, bucket=pad.as_tuple(), kind="predict",
+                    precision=str(self.predictor.compute_dtype),
+                )
+                try:
+                    self.executables[pad.as_tuple()] = load_artifact(
+                        self.artifact_dir, self.predictor.state,
+                        shape_structs(batch), ledger_entry=ledger_entry,
+                        **key,
+                    )
+                    report["serialized"][repr(pad)] = "loaded"
+                except ArtifactError as e:
+                    # loud, per-bucket: a fleet operator watching a slow
+                    # boot must see WHY the fast path was skipped
+                    print(
+                        f"[serve] endpoint {self.name!r} bucket {pad!r}: "
+                        f"serialized-AOT fallback to compile-from-source: "
+                        f"{e}",
+                        file=sys.stderr, flush=True,
+                    )
+                    self.executables[pad.as_tuple()], _ = save_artifact(
+                        self.artifact_dir, self.predictor.predict_step,
+                        self.predictor.state, shape_structs(batch),
+                        ledger_entry=ledger_entry, **key,
+                    )
+                    report["serialized"][repr(pad)] = "saved"
+            else:
+                self.executables[pad.as_tuple()] = aot_compile(
+                    self.predictor.predict_step,
+                    self.predictor.state,
+                    shape_structs(batch),
+                    ledger_entry=ledger_entry,
+                )
             report[repr(pad)] = round(time.perf_counter() - t0, 4)
         if self.cfg.quantize:
             report["quant"] = self.warm_quant()
@@ -526,6 +573,7 @@ class PredictionServer:
         denormalize: bool = False,
         flush_ms: float | None = None,
         max_batch_graphs: int | None = None,
+        artifact_dir: str | None = None,
     ) -> ModelEndpoint:
         """Register one servable model. ``config`` is its AUGMENTED config;
         the bucket table comes from ``buckets`` (explicit) or is derived from
@@ -563,7 +611,8 @@ class PredictionServer:
         )
         predictor = Predictor(model, state, config, donate_batch=True)
         ep = ModelEndpoint(name, predictor, buckets, example, cfg,
-                           denormalize=denormalize, calib_samples=samples)
+                           denormalize=denormalize, calib_samples=samples,
+                           artifact_dir=artifact_dir)
         self._models[name] = ep
         return ep
 

@@ -1,4 +1,4 @@
-"""Persistent XLA compilation cache.
+"""Persistent XLA compilation cache + serialized-AOT executable artifacts.
 
 First TPU compilation of a train step costs 20-40 s; the persistent cache
 makes every subsequent process start (reruns, HPO trials, the bench driver)
@@ -7,13 +7,40 @@ is pure TPU-side win.
 
 Env: ``HYDRAGNN_COMPILE_CACHE`` — a directory, ``0`` to disable. Default
 ``./.jax_cache``.
+
+The serialized-AOT artifact layer (:func:`save_artifact` /
+:func:`load_artifact`) goes one step further for the serving fleet: warm-up
+persists each per-(model, bucket) predict executable as a ``jax.export``
+StableHLO blob keyed like the cost ledger (model/bucket/kind/backend/
+precision), so a BOOTING replica deserializes and compiles the exact same
+program instead of re-tracing the model — the thing that makes autoscaling
+responsive. Artifacts are fingerprinted on the ABSTRACT call signature
+(arg shapes/dtypes/tree structure + jax version + backend + precision),
+never on parameter values, so a new checkpoint of the same architecture —
+the blue/green rollout case — reuses them; any mismatch raises a typed
+:class:`ArtifactError` for the caller to fall back LOUDLY to
+compile-from-source.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import re
+import struct
 
 _enabled = False
+
+#: File magic for serialized-AOT artifacts; bump the trailing digit on any
+#: layout change so a stale artifact fails the header check, not deserialize.
+ARTIFACT_MAGIC = b"HGNNAOT1"
+
+
+class ArtifactError(RuntimeError):
+    """A serialized-AOT artifact is missing, torn, or does not match the
+    current program's fingerprint. Callers treat this as 'compile from
+    source instead' — loudly, never silently."""
 
 
 def enable_compile_cache(default_dir: str = "./.jax_cache") -> str | None:
@@ -84,6 +111,206 @@ def aot_compile(jitted, *args, ledger_entry: dict | None = None):
         from ..telemetry import ledger as _ledger
 
         _ledger.record(compiled, compile_s=elapsed, **(ledger_entry or {}))
+    except Exception:
+        pass
+    return compiled
+
+
+def _register_export_pytrees(args) -> None:
+    """``jax.export`` refuses to serialize a pytree whose container types it
+    has not been told how to name — and a served call signature is full of
+    NamedTuples (``TrainState``, ``GraphBatch``, optax optimizer states).
+    Walk ``args`` and register every NamedTuple type under its
+    module-qualified name. Idempotent, and the SAME walk runs on the save
+    and load sides (both hold the call args), so writer and booting reader
+    always agree on the vocabulary."""
+    from jax import export as jax_export
+
+    seen: set = set()
+
+    def walk(x):
+        t = type(x)
+        if isinstance(x, tuple) and hasattr(t, "_fields"):
+            if t not in seen:
+                seen.add(t)
+                try:
+                    jax_export.register_namedtuple_serialization(
+                        t, serialized_name=f"{t.__module__}.{t.__qualname__}"
+                    )
+                except ValueError:
+                    pass  # already registered (earlier save/load this process)
+            for v in x:
+                walk(v)
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v)
+        elif isinstance(x, dict):
+            for v in x.values():
+                walk(v)
+
+    walk(args)
+
+
+def abstract_fingerprint(*args, precision: str | None = None,
+                         backend: str | None = None) -> str:
+    """Architecture-level fingerprint of an AOT call signature: the abstract
+    shapes/dtypes + pytree structure of ``args``, the jax version, the
+    backend platform, and the compute precision. Parameter VALUES are
+    deliberately excluded — two checkpoints of the same architecture share a
+    fingerprint, which is what lets a blue/green rollout boot new-weight
+    replicas from the old generation's artifacts."""
+    import jax
+
+    if backend is None:
+        backend = jax.default_backend()
+    leaves, treedef = jax.tree.flatten(shape_structs(args))
+    sig = {
+        "jax": jax.__version__,
+        "backend": str(backend),
+        "precision": str(precision),
+        "tree": str(treedef),
+        "leaves": [[list(x.shape), str(x.dtype)] for x in leaves],
+    }
+    blob = json.dumps(sig, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def artifact_path(artifact_dir: str, *, model: str, bucket,
+                  kind: str = "predict", precision: str | None = None) -> str:
+    """Filesystem path of one executable artifact, keyed like the cost
+    ledger: ``<dir>/<model>/<kind>--<precision>--<bucket>.aot`` with the
+    bucket repr sanitized + hash-suffixed (bucket reprs contain characters
+    no filesystem wants)."""
+    braw = str(bucket)
+    bsafe = re.sub(r"[^A-Za-z0-9._-]+", "_", braw).strip("_")[:80]
+    bhash = hashlib.sha1(braw.encode()).hexdigest()[:10]
+    psafe = re.sub(r"[^A-Za-z0-9._-]+", "_", str(precision))
+    return os.path.join(
+        artifact_dir, str(model), f"{kind}--{psafe}--{bsafe}-{bhash}.aot"
+    )
+
+
+def save_artifact(artifact_dir: str, jitted, *args, model: str, bucket,
+                  kind: str = "predict", precision: str | None = None,
+                  ledger_entry: dict | None = None):
+    """Export + persist one AOT signature and return its executable:
+    ``(compiled, path)``.
+
+    The executable handed back is compiled FROM the exported StableHLO (not
+    from the original traced function), i.e. the very same program a booting
+    worker gets back out of :func:`load_artifact` — so serialized boot is
+    bit-identical to the warm-up that wrote the artifact, by construction.
+    The write is atomic (tmp + ``os.replace``), matching the replica
+    ready-file discipline: a reader never sees a torn artifact, only the old
+    one or the new one.
+    """
+    import time
+
+    import jax
+    from jax import export as jax_export
+
+    t0 = time.perf_counter()
+    _register_export_pytrees(args)
+    exported = jax_export.export(jitted)(*args)
+    blob = exported.serialize()
+    header = {
+        "fingerprint": abstract_fingerprint(*args, precision=precision),
+        "model": str(model),
+        "bucket": str(bucket),
+        "kind": str(kind),
+        "precision": str(precision),
+        "backend": jax.default_backend(),
+        "jax": jax.__version__,
+    }
+    hdr = json.dumps(header, sort_keys=True).encode()
+    path = artifact_path(
+        artifact_dir, model=model, bucket=bucket, kind=kind,
+        precision=precision,
+    )
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(ARTIFACT_MAGIC)
+        f.write(struct.pack("<I", len(hdr)))
+        f.write(hdr)
+        f.write(blob)
+    os.replace(tmp, path)
+    compiled = jax.jit(exported.call).lower(*args).compile()
+    elapsed = time.perf_counter() - t0
+    try:
+        from ..telemetry import ledger as _ledger
+
+        _ledger.record(compiled, compile_s=elapsed, **(ledger_entry or {}))
+    except Exception:
+        pass
+    return compiled, path
+
+
+def load_artifact(artifact_dir: str, *args, model: str, bucket,
+                  kind: str = "predict", precision: str | None = None,
+                  ledger_entry: dict | None = None):
+    """Deserialize one persisted artifact and compile its StableHLO into a
+    live executable — seconds, vs minutes of trace + compile from source.
+
+    Raises :class:`ArtifactError` when the artifact is missing, torn, or its
+    fingerprint does not match the CURRENT abstract signature (different jax
+    version, backend, precision, or bucket shapes). Callers catch that and
+    fall back to compile-from-source loudly; they never serve a stale
+    program.
+    """
+    import jax
+
+    path = artifact_path(
+        artifact_dir, model=model, bucket=bucket, kind=kind,
+        precision=precision,
+    )
+    if not os.path.exists(path):
+        raise ArtifactError(f"no serialized artifact at {path}")
+    try:
+        with open(path, "rb") as f:
+            magic = f.read(len(ARTIFACT_MAGIC))
+            if magic != ARTIFACT_MAGIC:
+                raise ArtifactError(
+                    f"artifact {path} has bad magic {magic!r} (expected "
+                    f"{ARTIFACT_MAGIC!r}) — torn write or foreign file"
+                )
+            (hdr_len,) = struct.unpack("<I", f.read(4))
+            header = json.loads(f.read(hdr_len).decode())
+            blob = f.read()
+    except ArtifactError:
+        raise
+    except Exception as e:
+        raise ArtifactError(f"artifact {path} unreadable: {e!r}") from e
+    want = abstract_fingerprint(*args, precision=precision)
+    got = header.get("fingerprint")
+    if got != want:
+        raise ArtifactError(
+            f"artifact {path} fingerprint mismatch (artifact "
+            f"{str(got)[:12]}… from jax {header.get('jax')}/"
+            f"{header.get('backend')}, current {want[:12]}… from jax "
+            f"{jax.__version__}/{jax.default_backend()}) — recompiling "
+            "from source"
+        )
+    from jax import export as jax_export
+
+    import time
+
+    t0 = time.perf_counter()
+    _register_export_pytrees(args)
+    try:
+        exported = jax_export.deserialize(blob)
+        compiled = jax.jit(exported.call).lower(*args).compile()
+    except Exception as e:
+        raise ArtifactError(
+            f"artifact {path} failed to deserialize/compile: {e!r}"
+        ) from e
+    try:
+        from ..telemetry import ledger as _ledger
+
+        _ledger.record(
+            compiled, compile_s=time.perf_counter() - t0,
+            **(ledger_entry or {}),
+        )
     except Exception:
         pass
     return compiled

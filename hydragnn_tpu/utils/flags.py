@@ -223,6 +223,30 @@ FLEET_CACHE_BYTES = _register(Flag(
     "Keyed on canonicalized graph bytes + model + quant flag: a repeated "
     "graph is answered from the router, byte-identical to replica "
     "compute, at zero replica cost."))
+FLEET_AUTOSCALE = _register(Flag(
+    "HYDRAGNN_FLEET_AUTOSCALE", "bool", None,
+    "Arm the fleet SLO autoscaler (overrides Serving.fleet.autoscale."
+    "enabled, default off). The control loop polls FleetRouter.metrics() "
+    "and spawns/retires replicas against the interactive p99 + queue-depth "
+    "+ shed-rate targets, with hysteresis and cooldowns; retirement drains "
+    "in-flight work before the socket closes, so scaling down never loses "
+    "a request."))
+ROLLOUT_CANARY = _register(Flag(
+    "HYDRAGNN_ROLLOUT_CANARY", "bool", None,
+    "Require the bit-identity canary before a blue/green cutover "
+    "(overrides Serving.fleet.rollout.canary, default on). Green replicas "
+    "must serve answers byte-identical to the live set on a pinned probe "
+    "batch before the router swaps generations; a mismatch refuses the "
+    "rollout and leaves the live set untouched. =0 skips the proof — "
+    "only safe when the new checkpoint is known answer-compatible."))
+SERIALIZED_BOOT = _register(Flag(
+    "HYDRAGNN_SERIALIZED_BOOT", "bool", None,
+    "Boot replicas from persisted jax.export executable artifacts instead "
+    "of recompiling (overrides Serving.fleet.serialized_boot, default on). "
+    "Warm-up saves artifacts keyed model/bucket/backend/precision next to "
+    "the compile-cost ledger; a booting worker with a matching fingerprint "
+    "deserializes in seconds. A stale/missing artifact falls back to "
+    "compile-from-source LOUDLY (logged per bucket), never silently."))
 
 # -- bulk screening (hydragnn_tpu.screen) ------------------------------------
 SCREEN_PREFETCH = _register(Flag(

@@ -754,11 +754,25 @@ class HealthTable:
     pushes the re-probe deadline out by the current backoff and doubles
     the backoff up to the cap (``lift`` — a successful probe or fetch —
     removes the entry). Keys are caller-defined (peer ranks, replica
-    ids)."""
+    ids).
 
-    def __init__(self, base_s: float, cap_s: float):
+    Each deadline is jittered through the shared ``utils.retry`` policy —
+    a pure doubling clock is SYNCHRONIZED across clients (every router /
+    store that saw a peer die at the same instant re-probes it in the same
+    instant, a thundering herd against a just-recovering process);
+    ``jitter`` spreads the deadlines by up to that fraction of the backoff
+    (0 restores the old synchronized clock)."""
+
+    def __init__(self, base_s: float, cap_s: float, jitter: float = 0.25):
+        from .retry import RetryPolicy
+
         self.base_s = float(base_s)
         self.cap_s = float(cap_s)
+        # delay(1) = 1.0 * (1 + U[0, jitter]) — the shared jitter shape,
+        # applied as a multiplier on this table's own doubling backoff
+        self.policy = RetryPolicy(
+            attempts=1, base_delay=1.0, factor=1.0, jitter=float(jitter)
+        )
         self.lock = threading.Lock()
         # key -> {"until", "backoff", "failures"}; quarantined while
         # now < until AND the entry exists
@@ -783,7 +797,7 @@ class HealthTable:
                     "until": 0.0, "backoff": self.base_s, "failures": 0,
                 }
             h["failures"] += 1
-            h["until"] = time.monotonic() + h["backoff"]
+            h["until"] = time.monotonic() + h["backoff"] * self.policy.delay(1)
             h["backoff"] = min(h["backoff"] * 2.0, self.cap_s)
         return fresh
 

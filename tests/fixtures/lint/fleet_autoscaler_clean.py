@@ -1,0 +1,112 @@
+"""Sanctioned self-driving-fleet control-plane patterns
+(hydragnn_tpu/serve/fleet/autoscaler.py, rollout.py).
+
+The autoscaler and the blue/green rollout are HOST control code around the
+router: one owned polling thread, pure decision math, and a strict
+attach-before-retire ordering. Their shape must stay silent under every GL
+rule:
+
+- the decision core is a PURE function of (config, state, signals, now):
+  no locks, no clocks of its own, no I/O — trivially unit-testable and
+  invisible to every threading rule;
+- controller bookkeeping (the owned-replica map, the decision audit trail)
+  lives behind ONE lock with ``# guarded-by:`` declarations (GL101), and
+  reads hand back FRESH copies, never an alias of the guarded mutable
+  (GL107);
+- cooldown/hysteresis arithmetic uses ``time.monotonic()`` exclusively
+  (GL105) — wall clocks appear only as record FIELDS for humans;
+- the control thread is OWNED: started by its object, stop() sets the
+  event and joins (GL106), and a poll failure is recorded, never allowed
+  to kill the loop;
+- the rollout takes no locks at all: it drives the router's own
+  thread-safe surface in the one order that cannot drop requests (attach
+  green, THEN drain-and-retire blue), and the canary compare is pure
+  array math over probe answers — nothing here is jit-reachable
+  (GL001-GL004 have no surface).
+"""
+import threading
+import time
+
+HOLD = "hold"
+SCALE_UP = "scale_up"
+
+
+def clean_decide(cfg, state, sig, now):
+    """Pure decision math: streaks in, (action, reason) out."""
+    if sig["p99_ms"] is not None and sig["p99_ms"] > cfg["target_p99_ms"]:
+        state["breach_streak"] += 1
+    else:
+        state["breach_streak"] = 0
+    if now - state["last_action_at"] < cfg["cooldown_s"]:
+        return HOLD, "cooldown"
+    if state["breach_streak"] >= cfg["up_consecutive"]:
+        return SCALE_UP, "breach streak"
+    return HOLD, "within targets"
+
+
+class CleanAutoscaler:
+    """The control loop around the pure core: one owned thread, one lock."""
+
+    def __init__(self, router, cfg, spawn_fn):
+        self.router = router
+        self.cfg = cfg
+        self.spawn_fn = spawn_fn
+        self._lock = threading.Lock()
+        self._owned = {}  # guarded-by: _lock
+        self._actions = []  # guarded-by: _lock (decision audit trail)
+        self._stop = threading.Event()
+        self._thread = None
+        self.state = {"breach_streak": 0, "last_action_at": float("-inf")}
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.cfg["interval_s"]):
+            try:
+                self.step()
+            except Exception as e:  # a poll failure must not kill the loop
+                with self._lock:
+                    self._actions.append({"action": "error", "error": repr(e)})
+
+    def step(self, now=None):
+        now = time.monotonic() if now is None else now
+        sig = self.router.stats()
+        action, reason = clean_decide(self.cfg, self.state, sig, now)
+        if action == SCALE_UP:
+            handle = self.spawn_fn()
+            rank = self.router.attach(handle.host, handle.port)
+            with self._lock:
+                self._owned[rank] = handle
+            self.state["last_action_at"] = now
+        with self._lock:
+            self._actions.append({"action": action, "reason": reason})
+        return action, reason
+
+    def actions(self):
+        with self._lock:
+            return [dict(r) for r in self._actions]  # fresh copies out
+
+    def owned_ranks(self):
+        with self._lock:
+            return sorted(self._owned)
+
+
+def clean_rollout(router, green_addrs, drain_timeout_s):
+    """Attach green FIRST, then drain-and-retire blue: at every instant at
+    least one generation is attached, so zero requests drop. No locks of
+    its own — the router's surface is the synchronization."""
+    blue = list(router.active_ranks())
+    green = [router.attach(host, port) for host, port in green_addrs]
+    drained = {}
+    for rank in blue:
+        drained[rank] = router.retire(rank, timeout_s=drain_timeout_s)
+    return {"blue_ranks": blue, "green_ranks": green, "drained": drained}

@@ -262,6 +262,19 @@ def test_halo_exchange_shape_is_clean():
     assert findings == [], [f.format() for f in findings]
 
 
+def test_fleet_autoscaler_shape_is_clean():
+    """The self-driving-fleet control-plane shape (hydragnn_tpu/serve/
+    fleet/autoscaler.py, rollout.py: a pure decide core, one owned polling
+    thread with event-join teardown, owned-replica map + audit trail
+    behind one declared lock with fresh-copy reads, monotonic
+    cooldown/hysteresis clocks, and a lockless attach-green-first rollout
+    driving the router's own thread-safe surface) is sanctioned host
+    code: every rule — GL101/GL105/GL106/GL107 above all — must stay
+    silent on it."""
+    findings = analyze([str(FIXTURES / "fleet_autoscaler_clean.py")])
+    assert findings == [], [f.format() for f in findings]
+
+
 def test_gl003_scan_folded_steps_are_clean():
     """lax.scan-folded supersteps (train/superstep.py's pattern: one jitted
     scan built outside the loop, dispatched per block) are the sanctioned
@@ -414,6 +427,7 @@ def test_guarded_by_annotations_present_in_threaded_modules():
         "hydragnn_tpu/serve/server.py",
         "hydragnn_tpu/serve/fleet/router.py",
         "hydragnn_tpu/serve/fleet/cache.py",
+        "hydragnn_tpu/serve/fleet/autoscaler.py",
         "hydragnn_tpu/utils/wire.py",
         "hydragnn_tpu/datasets/sharded.py",
         "hydragnn_tpu/resilience/watchdog.py",

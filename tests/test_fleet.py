@@ -14,10 +14,18 @@ boot or real timing statistics rides the ``slow`` marker:
   byte-compat, config/flags plumbing, answer-cache LRU unit tests;
 * slow — replica KILL mid-stream over two real warm servers (second
   warm-up), the multi-PROCESS boot from checkpoint paths (subprocess
-  jax import + AOT warm-up), and the overload priority/p99 scenario.
+  jax import + AOT warm-up), the overload priority/p99 scenario, the
+  chaos traffic-replay campaign (second warm-up + seeded fault
+  schedules), and the true-subprocess serialized-AOT boot A/B (two
+  subprocess boots). The blue/green cutover + canary tests stay
+  NON-SLOW: both generations wrap the one warm server, so the rollout
+  machinery is exercised with zero extra warm-ups.
 """
 
 import copy
+import glob
+import json
+import os
 import socket
 import threading
 import time
@@ -31,6 +39,7 @@ from hydragnn_tpu.datasets import deterministic_graph_data
 from hydragnn_tpu.preprocess.load_data import dataset_loading_and_splitting
 from hydragnn_tpu.models.create import create_model_config
 from hydragnn_tpu.serve import (
+    CanaryMismatchError,
     DeadlineExceededError,
     FleetConfig,
     FleetRouter,
@@ -40,6 +49,7 @@ from hydragnn_tpu.serve import (
     ServerClosedError,
     ServingConfig,
     UnknownModelError,
+    blue_green_rollout,
     fleet_config_defaults,
     mixed_priority_plan,
     run_traffic,
@@ -511,6 +521,124 @@ def test_fleet_config_block_schema_and_flags(monkeypatch):
     assert cfg.replicas == 7 and cfg.cache_bytes == 999
 
 
+# -- non-slow: blue/green rollout ---------------------------------------------
+
+
+def test_blue_green_cutover_atomicity_and_zero_drop(warm_server):
+    """A request admitted DURING the swap is served exactly once and
+    bit-identical to the direct server; blue drains clean and retires;
+    the model set never blinks (green attaches before blue drains)."""
+    server, samples = warm_server["server"], warm_server["samples"]
+    blue = ReplicaHost(server)
+    green = ReplicaHost(server)  # same warm server: bit-identical twin
+    router = _router(blue, cache_bytes=0)
+    try:
+        direct = [_heads(server.submit("gin", s).result(timeout=30))
+                  for s in samples[:6]]
+        blue.set_delay(0.15)  # in-flight work genuinely spans the cutover
+        futs = [router.submit("gin", samples[i]) for i in range(3)]
+        box = {}
+
+        def _roll():
+            box["report"] = blue_green_rollout(
+                router, [green], probes=[("gin", samples[0])],
+                config={"rollout": {"canary_probes": 1}},
+            )
+
+        th = threading.Thread(target=_roll)
+        th.start()
+        # requests admitted while the rollout is in flight: whichever
+        # generation dispatch hands them to must serve them exactly once
+        mid = [router.submit("gin", samples[3 + i]) for i in range(3)]
+        th.join(timeout=60)
+        assert not th.is_alive(), "rollout wedged"
+        blue.set_delay(0.0)
+        got = [_heads(f.result(timeout=30)) for f in futs + mid]
+        for d, g in zip(direct, got):
+            assert len(d) == len(g) >= 1
+            for a, b in zip(d, g):
+                assert np.array_equal(a, b)  # bit-identical across cutover
+        st = router.stats()
+        assert st["served"] == 6 and st["failed"] == 0  # exactly once each
+        report = box["report"]
+        assert report["blue_ranks"] == [0]
+        assert report["green_ranks"] == [1]
+        assert all(report["drained"].values())  # zero dropped in the drain
+        assert report["canary"] == {0: "ok"}
+        assert router.active_ranks() == [1]
+        rows = {r["rank"]: r for r in st["replicas"]}
+        assert rows[0]["retired"] and not rows[1]["retired"]
+        # the retired rank takes no further traffic; green serves alone
+        after = router.submit("gin", samples[6]).result(timeout=30)
+        assert after["heads"]
+        assert {r["rank"]: r for r in
+                router.stats()["replicas"]}[0]["served"] <= 6
+    finally:
+        blue.set_delay(0.0)
+        router.stop()
+        green.close()
+        blue.close()
+
+
+class _WrongAnswerHost(wire.WireServer):
+    """A 'green' replica that answers the canary with the WRONG bits —
+    the rollout must refuse it before it ever attaches."""
+
+    def pong_fields(self):
+        return {
+            "ready": np.asarray(1, np.int64),
+            "models": wire.text_field("gin"),
+            "quantized": np.zeros(1, np.int64),
+        }
+
+    def handle_frame(self, z):
+        if "predict" in z:
+            return {
+                "n": np.asarray(1, np.int64),
+                "nheads": np.asarray(1, np.int64),
+                "latency_s": np.asarray(0.0, np.float64),
+                "h0": np.zeros((3, 1), np.float32),
+            }
+        raise ValueError(f"unexpected fleet op in frame keys {sorted(z)}")
+
+
+def test_canary_mismatch_refuses_rollout_live_set_untouched(warm_server):
+    """The bit-identity gate: a green generation whose served answers
+    diverge is refused with a typed CanaryMismatchError, the impostor is
+    never attached, and the live set keeps serving its own answers."""
+    server, samples = warm_server["server"], warm_server["samples"]
+    blue = ReplicaHost(server)
+    router = _router(blue, cache_bytes=0)
+    impostor = _WrongAnswerHost(host="127.0.0.1", port=0,
+                                name="WrongAnswerHost")
+    try:
+        before = [_heads(router.submit("gin", s).result(timeout=30))
+                  for s in samples[:2]]
+        with pytest.raises(CanaryMismatchError):
+            blue_green_rollout(
+                router, [("127.0.0.1", impostor.port)],
+                probes=[("gin", samples[0])],
+            )
+        st = router.stats()
+        assert len(st["replicas"]) == 1  # the impostor never attached
+        assert router.active_ranks() == [0]
+        assert not st["replicas"][0]["retired"]
+        after = [_heads(router.submit("gin", s).result(timeout=30))
+                 for s in samples[:2]]
+        for d, g in zip(before, after):
+            for a, b in zip(d, g):
+                assert np.array_equal(a, b)  # live set untouched
+        # canary=False skips the gate — config-routed, env-overridable —
+        # but an EMPTY probe list with the canary armed is a refusal too
+        with pytest.raises(ValueError, match="probe"):
+            blue_green_rollout(router, [("127.0.0.1", impostor.port)],
+                               probes=[])
+    finally:
+        router.stop()
+        impostor.close()
+        blue.close()
+
+
 # -- slow: second boot / multi-process / timing statistics --------------------
 
 
@@ -637,3 +765,171 @@ def test_subprocess_replica_boots_from_checkpoint_and_serves(
     finally:
         router.stop()
         worker.terminate()
+
+
+@pytest.mark.slow
+def test_chaos_traffic_replay_campaign(warm_server):
+    """The fleet chaos campaign end-to-end on CPU: seeded fleet-fault
+    schedules (replica kills, gray-failure slowdowns, a blue/green rollout
+    mid-load) fired at request coordinates into a Zipf + mixed-priority
+    replay over two real warm replicas, gated on the self-healing
+    invariants — zero lost requests, bounded service gaps, bit-identical
+    answers for every duplicate graph across kills AND the cutover (cache
+    OFF, so every duplicate recomputes on whatever generation serves it),
+    no leaked threads or subprocesses."""
+    from hydragnn_tpu.resilience import campaign
+    from hydragnn_tpu.resilience.chaos import FaultPlan
+
+    samples, aug = warm_server["samples"], warm_server["aug"]
+    model, state = warm_server["model"], warm_server["state"]
+    second = PredictionServer(ServingConfig(flush_ms=2.0))
+    second.add_model("gin", model, state, aug, samples=samples, batch_size=8)
+    second.warmup(verify=True)
+    second.start()
+    servers = [warm_server["server"], second]
+    n_requests = 40
+
+    def run_schedule(seed, events):
+        threads_before = campaign.nondaemon_thread_count()
+        hosts = [ReplicaHost(servers[0]), ReplicaHost(servers[1])]
+        greens = []
+        router = _router(*hosts, cache_bytes=0)
+        plan = FaultPlan.parse(json.dumps(events))
+
+        def _kill(ev):
+            hosts[ev.peer % len(hosts)].close()  # severed like a host loss
+
+        def _slow(ev):
+            hosts[ev.peer % len(hosts)].set_delay(ev.seconds)
+
+        def _rollout(ev):
+            g = ReplicaHost(servers[ev.peer % len(servers)])
+            greens.append(g)
+            for attempt in range(3):
+                try:
+                    blue_green_rollout(
+                        router, [g], probes=[("gin", samples[0])],
+                        config={"rollout": {"canary_probes": 1,
+                                            "drain_timeout_s": 20.0}},
+                    )
+                    return
+                except RuntimeError:
+                    # the reference replica died at exactly the wrong
+                    # instant (a kill landed just before the rollout):
+                    # the live set is untouched by contract, so retry
+                    if attempt == 2:
+                        raise
+                    time.sleep(0.5)
+
+        actions = {
+            "replica_kill": _kill,
+            "replica_slow": _slow,
+            "rollout_during_load": _rollout,
+        }
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # watchdog/failover notes
+                raw = campaign.replay_traffic_with_faults(
+                    router, "gin", samples[:16], n_requests, seed=seed,
+                    plan=plan, actions=actions, timeout_s=90.0,
+                )
+        finally:
+            router.stop()
+            for h in hosts + greens:
+                h.close()
+        return campaign.FleetOutcome(
+            seed=seed, events=events, n_requests=n_requests,
+            served=raw["served"], shed=raw["shed"], lost=raw["lost"],
+            lost_detail=raw["lost_detail"], answers=raw["answers"],
+            max_service_gap_ms=raw["max_service_gap_ms"],
+            recovery_budget_ms=30_000.0,
+            threads_before=threads_before,
+            threads_after=campaign.nondaemon_thread_count(),
+            leaked_procs=0,  # in-process replicas; the boot A/B covers procs
+        )
+
+    try:
+        report = campaign.run_fleet_campaign(
+            [0, 1, 2], run_schedule, n_requests=n_requests, n_replicas=2
+        )
+    finally:
+        second.stop()
+    assert report["passed"], report["violations"]
+    assert report["n_schedules"] == 3
+    # every schedule genuinely served traffic (the gate is not vacuous)
+    assert all(s["served"] > 0 for s in report["schedules"])
+    # Zipf duplicates mean the bit-identity check had real teeth: with 16
+    # distinct samples, any schedule serving more than 16 requests must
+    # have served some graph at least twice (pigeonhole)
+    assert any(s["served"] > 16 for s in report["schedules"])
+
+
+@pytest.mark.slow
+def test_serialized_boot_subprocess_ab(warm_server, tmp_path):
+    """True-subprocess serialized-AOT boot A/B: the first worker boots
+    compile-from-source and persists ``jax.export`` artifacts; a second
+    worker pointed at the same artifact dir DESERIALIZES them — proven by
+    the artifact files being byte-untouched after the second boot (a
+    fingerprint-mismatch fallback would re-save them) — and serves
+    bit-identically to the in-process server with zero steady lowerings."""
+    from hydragnn_tpu.config.schema import save_config
+    from hydragnn_tpu.serve.fleet.replica import (
+        spawn_replica,
+        write_samples_file,
+    )
+    from hydragnn_tpu.train.checkpoint import save_checkpoint
+
+    server, samples = warm_server["server"], warm_server["samples"]
+    aug, state = warm_server["aug"], warm_server["state"]
+    logs = str(tmp_path / "logs")
+    save_config(aug, "fleet_aot", path=logs)
+    save_checkpoint(state, "fleet_aot", epoch=0, path=logs)
+    samples_file = write_samples_file(
+        samples, str(tmp_path / "bucket_samples.wire")
+    )
+    artifacts = str(tmp_path / "aot")
+    spec = {
+        "models": [{
+            "name": "gin", "log_name": "fleet_aot", "path": logs,
+            "samples_file": samples_file, "batch_size": 8,
+            "artifact_dir": artifacts,
+        }],
+        "serving": {"flush_ms": 2.0},
+    }
+    env = {"JAX_PLATFORMS": "cpu"}
+    t0 = time.monotonic()
+    w1 = spawn_replica(spec, timeout_s=420.0, env=env)
+    cold_s = time.monotonic() - t0
+    try:
+        aot_files = sorted(glob.glob(os.path.join(artifacts, "gin", "*.aot")))
+        assert aot_files, "first boot persisted no artifacts"
+        sizes = [os.path.getsize(p) for p in aot_files]
+        mtimes = [os.path.getmtime(p) for p in aot_files]
+    finally:
+        w1.terminate()
+    t0 = time.monotonic()
+    w2 = spawn_replica(spec, timeout_s=420.0, env=env)
+    warm_s = time.monotonic() - t0
+    router = FleetRouter({"peer_timeout": 30.0, "cache_bytes": 0})
+    try:
+        router.attach("127.0.0.1", w2.port)
+        router.start()
+        probe = samples[:4]
+        direct = [_heads(server.submit("gin", s).result(timeout=30))
+                  for s in probe]
+        routed = [_heads(router.submit("gin", s).result(timeout=60))
+                  for s in probe]
+        for d, r in zip(direct, routed):
+            for a, b in zip(d, r):
+                assert np.array_equal(a, b)  # serialized boot: bit-identical
+        assert router.replica_stats(0)["steady_lowerings"] == 0
+        # the artifacts were LOADED, not fallback-recompiled: a fallback
+        # re-saves the file, which would move its mtime
+        again = sorted(glob.glob(os.path.join(artifacts, "gin", "*.aot")))
+        assert again == aot_files
+        assert [os.path.getsize(p) for p in again] == sizes
+        assert [os.path.getmtime(p) for p in again] == mtimes
+        print(f"[serialized-boot] cold {cold_s:.1f}s -> warm {warm_s:.1f}s")
+    finally:
+        router.stop()
+        w2.terminate()

@@ -1,0 +1,436 @@
+"""Self-driving fleet control-plane tests (ISSUE 20): SLO autoscaler,
+rollout/autoscale config blocks, serialized-AOT artifacts, ready-file
+hardening, quarantine jitter, and the fleet chaos campaign units.
+
+Slow-mark budget, decided UP FRONT: everything here is NON-SLOW by
+design. The autoscaler's decision loop runs against a FAKE router with a
+pinned clock (no sockets, no sleeps, no model) — the stand-in the slow
+chaos e2e rides on; the serialized-AOT round trip exports a toy jitted
+program in process — the stand-in for the true-subprocess boot A/B. Both
+slow twins live in ``tests/test_fleet.py`` next to the topologies they
+need.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.resilience import campaign
+from hydragnn_tpu.resilience.chaos import FLEET_FAULTS, FaultPlan
+from hydragnn_tpu.serve.fleet.autoscaler import (
+    HOLD,
+    SCALE_DOWN,
+    SCALE_UP,
+    Autoscaler,
+    AutoscalerState,
+    Signals,
+    decide,
+)
+from hydragnn_tpu.serve.fleet.config import (
+    AutoscalerConfig,
+    FleetConfig,
+    RolloutConfig,
+    autoscaler_config_defaults,
+    fleet_config_defaults,
+    rollout_config_defaults,
+)
+from hydragnn_tpu.serve.fleet.replica import ReplicaBootError, _read_ready_file
+from hydragnn_tpu.utils import wire
+from hydragnn_tpu.utils.compile_cache import (
+    ArtifactError,
+    abstract_fingerprint,
+    load_artifact,
+    save_artifact,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _threadsan(threadsan_module):
+    """Autoscaler/rollout/config locks run under the lock-order sanitizer
+    for the whole module; teardown asserts cycle-free."""
+    yield threadsan_module
+
+
+# -- fakes: the no-socket substrate the decision loop is tested on ------------
+
+
+class _FakeHandle:
+    """What spawn_fn returns: addressable + terminate()-able."""
+
+    _next_port = 9700
+
+    def __init__(self):
+        _FakeHandle._next_port += 1
+        self.host = "127.0.0.1"
+        self.port = _FakeHandle._next_port
+        self.terminated = False
+
+    def terminate(self):
+        self.terminated = True
+
+
+class _FakeRouter:
+    """Scripted stats + attach/retire bookkeeping — the router surface the
+    autoscaler consumes, with the SLO signals as writable knobs."""
+
+    def __init__(self, replicas=1):
+        self.ranks = list(range(replicas))
+        self._next = replicas
+        self.p99 = 10.0
+        self.queue = 0
+        self.shed = 0
+        self.retired = []
+
+    def stats(self):
+        return {
+            "queue_depths": {"interactive": self.queue},
+            "latency_p99_ms": {"interactive": self.p99},
+            "shed": self.shed,
+            "active_replicas": len(self.ranks),
+        }
+
+    def attach(self, host, port):
+        rank = self._next
+        self._next += 1
+        self.ranks.append(rank)
+        return rank
+
+    def retire(self, rank, timeout_s=30.0):
+        self.ranks.remove(rank)
+        self.retired.append(rank)
+        return True
+
+    def active_ranks(self):
+        return list(self.ranks)
+
+
+def _cfg(**kw):
+    kw.setdefault("enabled", True)
+    kw.setdefault("target_p99_ms", 100.0)
+    kw.setdefault("up_consecutive", 2)
+    kw.setdefault("down_consecutive", 3)
+    kw.setdefault("cooldown_s", 5.0)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    return AutoscalerConfig(**kw)
+
+
+# -- the decision loop over fake replicas (the chaos e2e's stand-in) ----------
+
+
+def test_autoscaler_decision_loop_scales_up_and_down():
+    """The full control story with a pinned clock: breach streak -> spawn,
+    cooldown -> hold, persisting breach -> second spawn, at-max -> hold,
+    calm streak -> drain-and-retire newest owned, never below min."""
+    router = _FakeRouter(replicas=1)
+    spawned = []
+
+    def spawn():
+        h = _FakeHandle()
+        spawned.append(h)
+        return h
+
+    a = Autoscaler(router, _cfg(), spawn_fn=spawn)
+    router.p99 = 250.0  # SLO breach
+    assert a.step(now=0.0)[0] == HOLD  # one bursty poll is noise
+    act, reason = a.step(now=1.0)
+    assert act == SCALE_UP and "p99" in reason  # a streak is load
+    assert len(router.ranks) == 2 and len(spawned) == 1
+    assert a.step(now=2.0) == (HOLD, "cooldown")
+    a.step(now=3.0)  # streak rebuilds under cooldown
+    act, _ = a.step(now=7.0)  # cooldown over, breach persists: act NOW
+    assert act == SCALE_UP
+    assert len(router.ranks) == 3
+    # at max_replicas the loop holds and says so
+    a.step(now=13.0)
+    act, reason = a.step(now=14.0)
+    assert act == HOLD and "max_replicas" in reason
+    # calm must prove itself for down_consecutive polls
+    router.p99 = 10.0  # under down_fraction * target
+    assert a.step(now=20.0)[0] == HOLD
+    assert a.step(now=21.0)[0] == HOLD
+    act, reason = a.step(now=22.0)
+    assert act == SCALE_DOWN and "calm" in reason
+    assert router.retired == [2]  # newest owned rank retires first
+    assert spawned[1].terminated and not spawned[0].terminated
+    # next calm streak retires the remaining owned rank...
+    for t in (28.0, 29.0, 30.0):
+        act, _ = a.step(now=t)
+    assert act == SCALE_DOWN and router.retired == [2, 1]
+    assert spawned[0].terminated
+    # ...but never the seed topology below min_replicas (nothing owned)
+    for t in (36.0, 37.0, 38.0, 39.0):
+        act, _ = a.step(now=t)
+    assert act == HOLD and router.ranks == [0]
+    # every decision landed in the audit trail
+    assert len(a.actions) == 17
+    assert sum(1 for r in a.actions if r["action"] == SCALE_UP) == 2
+    assert sum(1 for r in a.actions if r["action"] == SCALE_DOWN) == 2
+
+
+def test_autoscaler_breach_kinds_and_streak_resets():
+    cfg = _cfg()
+    router = _FakeRouter(replicas=2)
+    a = Autoscaler(router, cfg, spawn_fn=_FakeHandle)
+    # backlog breach: queue above max_queue_per_replica * active
+    router.queue = cfg.max_queue_per_replica * 2 + 1
+    a.step(now=0.0)
+    act, reason = a.step(now=1.0)
+    assert act == SCALE_UP and "backlog" in reason
+    # shed-RATE breach: the counter delta per poll, not the absolute value
+    router2 = _FakeRouter(replicas=2)
+    b = Autoscaler(router2, cfg, spawn_fn=_FakeHandle)
+    router2.shed = 50
+    b.step(now=0.0)  # first poll swallows the baseline... and breaches
+    router2.shed = 50  # no NEW sheds: not a breach
+    assert b.state.breach_streak <= 1
+    b.step(now=1.0)
+    assert b.state.breach_streak == 0
+    # p99 between down threshold and target: neither breach nor calm,
+    # BOTH streaks reset — a decision needs an unbroken run of evidence
+    st = AutoscalerState(breach_streak=1, calm_streak=2)
+    sig = Signals(p99_ms=50.0, queue_depth=0, shed_total=0,
+                  active_replicas=2)
+    act, reason = decide(cfg, st, sig, now=100.0)
+    assert act == HOLD and st.breach_streak == 0 and st.calm_streak == 0
+
+
+def test_autoscaler_lifecycle_and_signal_extraction():
+    router = _FakeRouter()
+    with pytest.raises(ValueError, match="spawn_fn"):
+        Autoscaler(router, _cfg()).start()
+    # context-managed thread starts and joins clean (threadsan watches)
+    a = Autoscaler(router, _cfg(interval_s=30.0), spawn_fn=_FakeHandle)
+    with a:
+        assert a._thread.is_alive()
+    assert a._thread is None
+    # Signals.from_stats reads the router stats vocabulary; absent keys
+    # degrade to inert values instead of crashing the control loop
+    sig = Signals.from_stats({
+        "queue_depths": {"interactive": 3, "batch": 4},
+        "latency_p99_ms": {"interactive": 120.5},
+        "shed": 7, "active_replicas": 2,
+    })
+    assert sig == Signals(p99_ms=120.5, queue_depth=7, shed_total=7,
+                          active_replicas=2)
+    assert Signals.from_stats({}) == Signals(
+        p99_ms=None, queue_depth=0, shed_total=0, active_replicas=0
+    )
+
+
+# -- config blocks: single-sourced, unknown-key-rejecting, env-overridable ----
+
+
+def test_autoscale_rollout_config_blocks_and_flags(monkeypatch):
+    # the nested defaults ARE the dataclass defaults (single source)
+    assert fleet_config_defaults()["autoscale"] == autoscaler_config_defaults()
+    assert fleet_config_defaults()["rollout"] == rollout_config_defaults()
+    # unknown keys rejected at every level
+    with pytest.raises(ValueError, match="target_p99_mz"):
+        AutoscalerConfig.from_config({"autoscale": {"target_p99_mz": 1}})
+    with pytest.raises(ValueError, match="canary_probez"):
+        RolloutConfig.from_config({"rollout": {"canary_probez": 1}})
+    with pytest.raises(ValueError, match="bogus"):
+        FleetConfig(autoscale={"bogus": 1}).validate()
+    with pytest.raises(ValueError, match="bogus"):
+        FleetConfig(rollout={"bogus": 1}).validate()
+    # value ranges travel through the nested validation too
+    with pytest.raises(ValueError, match="down_fraction"):
+        AutoscalerConfig(down_fraction=1.5).validate()
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscalerConfig(min_replicas=4, max_replicas=2).validate()
+    with pytest.raises(ValueError, match="canary_probes"):
+        RolloutConfig(canary_probes=0).validate()
+    with pytest.raises(ValueError, match="down_fraction"):
+        FleetConfig(autoscale={"down_fraction": 2.0}).validate()
+    with pytest.raises(ValueError, match="boot_timeout_s"):
+        FleetConfig(boot_timeout_s=0).validate()
+    with pytest.raises(ValueError, match="quarantine_jitter"):
+        FleetConfig(quarantine_jitter=-0.1).validate()
+    # nested blocks resolve from the full config nesting
+    cfg = AutoscalerConfig.from_config(
+        {"Serving": {"fleet": {"autoscale": {"target_p99_ms": 42.0}}}}
+    )
+    assert cfg.target_p99_ms == 42.0 and cfg.enabled is False
+    # the three new flags override their knobs
+    monkeypatch.setenv("HYDRAGNN_FLEET_AUTOSCALE", "1")
+    monkeypatch.setenv("HYDRAGNN_ROLLOUT_CANARY", "0")
+    monkeypatch.setenv("HYDRAGNN_SERIALIZED_BOOT", "0")
+    fc = FleetConfig.from_config(None)
+    assert fc.serialized_boot is False
+    assert fc.autoscaler_config().enabled is True
+    assert fc.rollout_config().canary is False
+
+
+# -- satellite hardening: ready files, boot timeout, quarantine jitter --------
+
+
+def test_ready_file_hardening_typed_errors(tmp_path):
+    """A torn/garbage/contract-violating ready file raises ReplicaBootError
+    naming the path and the partial contents — never an opaque
+    JSONDecodeError from inside the poll loop."""
+    torn = tmp_path / "ready.json"
+    torn.write_text('{"port": 51')
+    with pytest.raises(ReplicaBootError, match="partial contents") as e:
+        _read_ready_file(str(torn))
+    assert '{"port": 51' in str(e.value) and "ready.json" in str(e.value)
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2]")
+    with pytest.raises(ReplicaBootError, match="boot contract"):
+        _read_ready_file(str(bad))
+    with pytest.raises(ReplicaBootError, match="unreadable"):
+        _read_ready_file(str(tmp_path / "missing.json"))
+    ok = tmp_path / "ok.json"
+    ok.write_text('{"port": 1234, "pid": 7}')
+    assert _read_ready_file(str(ok))["port"] == 1234
+    err = tmp_path / "err.json"
+    err.write_text('{"error": "boom"}')
+    assert _read_ready_file(str(err))["error"] == "boom"
+
+
+def test_spawn_replica_boot_timeout_from_config():
+    """spawn_replica's default deadline comes from the spec's
+    Serving.fleet.boot_timeout_s — one knob, not a hardcoded constant."""
+    from hydragnn_tpu.serve.fleet.replica import spawn_replica
+
+    spec = {"models": [], "serving": {"fleet": {"boot_timeout_s": 0.3}}}
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="0.3"):
+        spawn_replica(spec)  # worker can't finish importing jax in 0.3 s
+    assert time.monotonic() - t0 < 60.0
+
+
+def test_health_table_quarantine_backoff_jitter():
+    """Each quarantine deadline is spread by up to `jitter` of the backoff
+    (desynchronizing re-probes across clients); jitter=0 restores the old
+    synchronized doubling clock; the doubling itself is unchanged."""
+    ht = wire.HealthTable(base_s=1.0, cap_s=8.0, jitter=0.5)
+    spans = []
+    for k in range(40):
+        now = time.monotonic()
+        ht.bump(k)
+        spans.append(ht.entries[k]["until"] - now)
+    assert all(0.99 <= s <= 1.51 for s in spans), spans
+    assert max(spans) - min(spans) > 0.02  # genuinely spread, not pinned
+    ht0 = wire.HealthTable(base_s=1.0, cap_s=8.0, jitter=0.0)
+    now = time.monotonic()
+    ht0.bump("a")
+    assert abs((ht0.entries["a"]["until"] - now) - 1.0) < 0.05
+    now = time.monotonic()
+    ht0.bump("a")  # backoff doubled, no jitter
+    assert abs((ht0.entries["a"]["until"] - now) - 2.0) < 0.05
+    assert ht0.entries["a"]["backoff"] == 4.0
+
+
+# -- serialized-AOT artifacts (the subprocess boot A/B's stand-in) ------------
+
+
+def test_serialized_artifact_round_trip_bit_identical(tmp_path):
+    """Export -> serialize -> deserialize -> compile answers bit-identically
+    to the executable that wrote the artifact; mismatched fingerprints,
+    torn files, and missing artifacts all refuse typed."""
+    import jax
+    import jax.numpy as jnp
+
+    jitted = jax.jit(lambda x: jnp.sin(x) * 2.0 + x.sum())
+    x = np.linspace(0.0, 3.0, 16, dtype=np.float32)
+    compiled, path = save_artifact(
+        str(tmp_path), jitted, x, model="toy", bucket=(16,)
+    )
+    assert os.path.exists(path) and path.endswith(".aot")
+    loaded = load_artifact(str(tmp_path), x, model="toy", bucket=(16,))
+    np.testing.assert_array_equal(
+        np.asarray(compiled(x)), np.asarray(loaded(x))
+    )
+    # the fingerprint keys on ARCHITECTURE (shapes/dtypes/precision), not
+    # values: new weights of the same shape reuse the old artifacts —
+    # which is what lets blue/green boot green off blue's artifact store
+    assert abstract_fingerprint(x) == abstract_fingerprint(x * 7.0)
+    assert abstract_fingerprint(x) != abstract_fingerprint(x[:8])
+    assert abstract_fingerprint(x, precision="float32") != abstract_fingerprint(
+        x, precision="bfloat16"
+    )
+    # same key, different shapes: typed refusal naming the mismatch
+    with pytest.raises(ArtifactError, match="fingerprint mismatch"):
+        load_artifact(
+            str(tmp_path), np.zeros(8, np.float32), model="toy", bucket=(16,)
+        )
+    # torn/foreign file: bad magic, typed
+    with open(path, "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(ArtifactError, match="torn write or foreign"):
+        load_artifact(str(tmp_path), x, model="toy", bucket=(16,))
+    # missing artifact: typed (the boot path's compile-from-source branch)
+    with pytest.raises(ArtifactError, match="no serialized artifact"):
+        load_artifact(str(tmp_path), x, model="toy", bucket=(99,))
+
+
+# -- fleet chaos schedule + invariant gate units ------------------------------
+
+
+def test_fleet_fault_schedule_constraints_and_on_request():
+    assert campaign.FLEET_VOCAB == FLEET_FAULTS
+    for seed in range(12):
+        ev = campaign.random_fleet_schedule(seed, n_requests=50, n_replicas=2)
+        assert ev == campaign.random_fleet_schedule(
+            seed, n_requests=50, n_replicas=2
+        )
+        assert 1 <= len(ev) <= 3
+        assert all(e["fault"] in campaign.FLEET_VOCAB for e in ev)
+        assert all(0 <= e["dispatch"] < 50 for e in ev)
+        kills = [e for e in ev if e["fault"] == "replica_kill"]
+        assert len(kills) <= 1  # a survivor must exist
+        for k in kills:
+            assert 50 // 4 <= k["dispatch"] < 3 * 50 // 4  # mid-stream
+        assert sum(e["fault"] == "rollout_during_load" for e in ev) <= 1
+    # one replica: kills pruned from the vocabulary
+    for seed in range(8):
+        ev = campaign.random_fleet_schedule(seed, n_requests=30, n_replicas=1)
+        assert not any(e["fault"] == "replica_kill" for e in ev)
+    # schedules round-trip through the chaos plan parser and fire in
+    # request order through the actions adapter
+    events = [
+        {"fault": "replica_slow", "dispatch": 2, "peer": 1, "seconds": 0.3},
+        {"fault": "rollout_during_load", "dispatch": 4},
+    ]
+    plan = FaultPlan.parse(json.dumps(events))
+    fired = []
+    actions = {
+        "replica_kill": lambda e: fired.append(("kill", e.peer)),
+        "replica_slow": lambda e: fired.append(("slow", e.peer, e.seconds)),
+        "rollout_during_load": lambda e: fired.append(("rollout",)),
+    }
+    for i in range(6):
+        plan.on_request(i, actions)
+    assert fired == [("slow", 1, 0.3), ("rollout",)]
+    assert plan.log == [("replica_slow", 0, 2), ("rollout_during_load", 0, 4)]
+    # an unbound fault is an inert stderr note, not a crash mid-drill
+    assert FaultPlan.parse(
+        '{"fault": "replica_kill", "dispatch": 0}'
+    ).on_request(0, {}) == []
+
+
+def test_fleet_invariant_gate():
+    good = campaign.FleetOutcome(
+        seed=1, events=[], n_requests=10, served=9, shed=1, lost=0,
+        answers={0: {"aa"}, 3: {"bb"}}, max_service_gap_ms=120.0,
+        threads_before=3, threads_after=3,
+    )
+    assert campaign.check_fleet_invariants(good) == []
+    bad = campaign.FleetOutcome(
+        seed=2, events=[], n_requests=10, served=7, shed=0, lost=1,
+        lost_detail=["sample 0: TimeoutError: hung"],
+        answers={0: {"aa", "cc"}}, max_service_gap_ms=99_999.0,
+        threads_before=3, threads_after=5, leaked_procs=2,
+    )
+    v = campaign.check_fleet_invariants(bad)
+    assert len(v) == 6, v
+    assert any("accounting hole" in s for s in v)
+    assert any("LOST" in s and "TimeoutError" in s for s in v)
+    assert any("bit-identity" in s for s in v)
+    assert any("SLO-recovery" in s for s in v)
+    assert any("thread(s) leaked" in s for s in v)
+    assert any("subprocess(es) still alive" in s for s in v)
